@@ -1,0 +1,34 @@
+"""elephas_tpu — a TPU-native distributed deep-learning framework.
+
+A from-scratch rebuild of the capabilities of ``marcoleewow/elephas``
+(Spark-distributed Keras training; see SURVEY.md) on JAX/XLA for TPU:
+
+- Spark executors          -> TPU devices in a ``jax.sharding.Mesh``
+- TF-CPU per-worker compute-> per-chip ``jax.jit`` train steps
+- Flask/socket param server-> ICI allreduce (``lax.psum``) for synchronous
+                              data parallelism; an HBM-resident parameter
+                              buffer (+ optional HTTP/socket transports for
+                              cross-host control plane) for asynchronous /
+                              hogwild (Downpour SGD) modes
+- Spark RDDs               -> ``ShardedDataset`` (device-sharded numpy)
+- Spark-ML Pipeline stages -> columnar ``DataFrame`` + Estimator/Transformer
+- Hyperas/hyperopt search  -> device-parallel independent trials
+
+Driver-side API parity targets (reference symbols, SURVEY.md §2.1):
+``elephas/spark_model.py::SparkModel``, ``elephas/ml_model.py::
+ElephasEstimator``, ``elephas/hyperparam.py::HyperParamModel``.
+(The reference mount was empty at build time; citations are given as
+``file::Symbol`` per SURVEY.md's provenance note.)
+"""
+
+__version__ = "0.1.0"
+
+from elephas_tpu.api.spark_model import (  # noqa: F401
+    SparkModel,
+    SparkMLlibModel,
+    TpuModel,
+    load_spark_model,
+)
+from elephas_tpu.api.compile import CompiledModel, compile_model  # noqa: F401
+from elephas_tpu.data.rdd import ShardedDataset, to_simple_rdd  # noqa: F401
+from elephas_tpu.data.dataframe import DataFrame  # noqa: F401

@@ -1,0 +1,1 @@
+"""Driver-side API façades (reference L4/L5 — SURVEY.md §1)."""

@@ -1,0 +1,185 @@
+"""CompiledModel — the unit the driver API trains and ships.
+
+Reference parity: ``SparkModel`` ingests a *compiled* Keras model (loss +
+optimizer + metrics attached; ``elephas/spark_model.py::SparkModel.__init__``,
+SURVEY.md §2.1). The TPU-native equivalent binds a flax ``nn.Module`` to
+an optax optimizer, a named loss, and named metrics — everything a jitted
+train step needs, in one picklable object.
+
+Optimizers/losses/metrics accept Keras-style string names so reference
+user code translates 1:1; flax modules are the first-class citizens
+(SURVEY.md §7 hard part 2 — a Keras-3 ingestion bridge lives separately in
+``elephas_tpu.serialize.keras_bridge``).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from elephas_tpu.engine.losses import resolve_loss, resolve_metric
+
+# name -> (builder, default kwargs). Learning-rate defaults follow Keras.
+OPTIMIZERS: Dict[str, Tuple[Callable, Dict[str, Any]]] = {
+    "sgd": (optax.sgd, {"learning_rate": 0.01}),
+    "momentum": (optax.sgd, {"learning_rate": 0.01, "momentum": 0.9}),
+    "adam": (optax.adam, {"learning_rate": 0.001}),
+    "adamw": (optax.adamw, {"learning_rate": 0.001}),
+    "rmsprop": (optax.rmsprop, {"learning_rate": 0.001}),
+    "adagrad": (optax.adagrad, {"learning_rate": 0.01}),
+    "lamb": (optax.lamb, {"learning_rate": 0.001}),
+}
+
+
+def resolve_optimizer(optimizer) -> Tuple[optax.GradientTransformation, Optional[dict]]:
+    """Resolve an optimizer spec to (transform, serializable_config).
+
+    Accepts an optax transform (config None — not re-serializable), a
+    Keras-style name, or ``{"name": ..., **kwargs}``.
+    """
+    if isinstance(optimizer, str):
+        spec = {"name": optimizer}
+    elif isinstance(optimizer, dict):
+        spec = dict(optimizer)
+    else:
+        return optimizer, None
+    name = spec.pop("name").lower()
+    if name not in OPTIMIZERS:
+        raise ValueError(f"unknown optimizer {name!r}; known: {sorted(OPTIMIZERS)}")
+    builder, defaults = OPTIMIZERS[name]
+    kwargs = {**defaults, **spec}
+    return builder(**kwargs), {"name": name, **kwargs}
+
+
+class CompiledModel:
+    """A flax module bound to optimizer/loss/metrics (+ initial variables).
+
+    Parameters
+    ----------
+    module: flax ``nn.Module``.
+    params: parameter pytree; if ``None``, initialized from ``input_shape``.
+    optimizer: optax transform | name | ``{"name": ..., **kw}``.
+    loss / metrics: Keras-style names or callables (see ``engine.losses``).
+    input_shape: per-example shape (no batch dim) for lazy init.
+    input_dtype: dtype of the dummy init input (e.g. int32 for token ids).
+    model_config: ``{"name": ..., "kwargs": ...}`` when the module came
+        from the ``elephas_tpu.models`` registry — enables arch
+        serialization without pickling (SURVEY.md §2.1 serialization row).
+    """
+
+    def __init__(
+        self,
+        module,
+        params=None,
+        *,
+        optimizer="sgd",
+        loss="categorical_crossentropy",
+        metrics: Sequence = ("acc",),
+        input_shape: Optional[Tuple[int, ...]] = None,
+        input_dtype=jnp.float32,
+        batch_stats=None,
+        seed: int = 0,
+        model_config: Optional[dict] = None,
+    ):
+        self.module = module
+        # Keep the original specs: strings serialize by name, callables by
+        # pickle (see serialize.serialization.model_to_dict).
+        self.loss_spec = loss
+        self.metric_specs = list(metrics)
+        self.loss_name = loss if isinstance(loss, str) else getattr(loss, "__name__", "custom")
+        self.loss_fn = resolve_loss(loss)
+        self.metric_names = [
+            m if isinstance(m, str) else getattr(m, "__name__", "metric") for m in metrics
+        ]
+        self.metric_fns = [resolve_metric(m) for m in metrics]
+        self.optimizer, self.optimizer_config = resolve_optimizer(optimizer)
+        self.model_config = model_config or getattr(module, "_elephas_config", None)
+        self.input_shape = tuple(input_shape) if input_shape is not None else None
+        self.input_dtype = input_dtype
+
+        call_params = inspect.signature(type(module).__call__).parameters
+        self._takes_train = "train" in call_params
+
+        if params is None:
+            if input_shape is None:
+                raise ValueError("need either params or input_shape to initialize")
+            dummy = jnp.zeros((1, *self.input_shape), dtype=input_dtype)
+            variables = module.init(jax.random.PRNGKey(seed), dummy, **self._train_kwargs(False))
+            params = variables["params"]
+            batch_stats = variables.get("batch_stats", {})
+        self.params = params
+        self.batch_stats = batch_stats if batch_stats is not None else {}
+
+    # -- functional apply ------------------------------------------------------
+
+    def _train_kwargs(self, train: bool) -> dict:
+        return {"train": train} if self._takes_train else {}
+
+    @property
+    def has_batch_stats(self) -> bool:
+        return bool(jax.tree_util.tree_leaves(self.batch_stats))
+
+    def apply_train(self, params, batch_stats, x, rng):
+        """Training-mode forward. Returns (outputs, new_batch_stats)."""
+        variables = {"params": params}
+        if self.has_batch_stats:
+            variables["batch_stats"] = batch_stats
+            outputs, mutated = self.module.apply(
+                variables,
+                x,
+                mutable=["batch_stats"],
+                rngs={"dropout": rng},
+                **self._train_kwargs(True),
+            )
+            return outputs, mutated["batch_stats"]
+        outputs = self.module.apply(
+            variables, x, rngs={"dropout": rng}, **self._train_kwargs(True)
+        )
+        return outputs, batch_stats
+
+    def apply_eval(self, params, batch_stats, x):
+        """Inference-mode forward (deterministic, frozen stats)."""
+        variables = {"params": params}
+        if self.has_batch_stats:
+            variables["batch_stats"] = batch_stats
+        return self.module.apply(variables, x, **self._train_kwargs(False))
+
+    def init_opt_state(self, params=None):
+        return self.optimizer.init(params if params is not None else self.params)
+
+    # -- Keras-flavored convenience -------------------------------------------
+
+    def get_weights(self):
+        """Current weights as a pytree (reference returns list-of-ndarray)."""
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def count_params(self) -> int:
+        from elephas_tpu.utils.functional_utils import tree_size
+
+        return int(tree_size(self.params))
+
+    def clone(self) -> "CompiledModel":
+        """Same architecture + hyperparams, same (shared) initial weights."""
+        return CompiledModel(
+            self.module,
+            params=self.params,
+            optimizer=self.optimizer_config or self.optimizer,
+            loss=self.loss_spec,
+            metrics=list(self.metric_specs),
+            batch_stats=self.batch_stats,
+            model_config=self.model_config,
+            input_shape=self.input_shape,
+            input_dtype=self.input_dtype,
+        )
+
+
+def compile_model(module, **kwargs) -> CompiledModel:
+    """Functional alias mirroring ``keras.Model.compile`` usage."""
+    return CompiledModel(module, **kwargs)

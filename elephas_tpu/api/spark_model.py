@@ -1,0 +1,312 @@
+"""SparkModel-compatible driver API (reference L4).
+
+Reference: ``elephas/spark_model.py::{SparkModel, SparkMLlibModel,
+load_spark_model}`` (SURVEY.md §2.1, §3.1, §3.2, §3.5). The constructor
+signature, mode/frequency semantics, and fit/predict/evaluate/save surface
+are preserved; Spark executors are replaced by devices of a
+``jax.sharding.Mesh``, and the parameter server by ICI collectives (sync)
+or an HBM-resident parameter buffer (async/hogwild).
+
+Mode map (SURVEY.md §2.2):
+- ``synchronous``  -> SPMD shard_map training, ``lax.pmean`` coordination.
+- ``asynchronous`` -> per-device Downpour loops against a locked buffer.
+- ``hogwild``      -> same loops, lock-free buffer.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+from typing import Dict, List, Optional, Tuple, Union
+
+import jax
+import numpy as np
+
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.data.rdd import ShardedDataset, lp_to_simple_rdd
+from elephas_tpu.engine.step import init_train_state
+from elephas_tpu.engine.sync import SyncTrainer
+from elephas_tpu.parallel.mesh import build_mesh
+
+logger = logging.getLogger(__name__)
+
+MODES = ("synchronous", "asynchronous", "hogwild")
+FREQUENCIES = ("batch", "epoch", "fit")
+
+
+class TpuModel:
+    """Driver-side distributed model (the reference's ``SparkModel``).
+
+    Parameters mirror the reference constructor
+    (``elephas/spark_model.py::SparkModel.__init__``):
+
+    mode: 'synchronous' | 'asynchronous' | 'hogwild'.
+    frequency: coordination granularity. 'batch' | 'epoch' (reference
+        values; applies to async pull/push cadence and to sync averaging
+        granularity) plus 'fit' (sync only: the reference's
+        average-once-per-fit parity behavior).
+    parameter_server_mode: 'local' (in-process HBM buffer) | 'http' |
+        'socket' (cross-host transports, reference parity).
+    num_workers: logical shard count; defaults to the number of devices.
+        Capped to the device count (one worker == one chip).
+    port: parameter-server port for http/socket transports.
+    custom_objects: name->builder overrides used when deserializing.
+    batch_size: default per-worker batch size for ``fit``.
+    mesh: optional pre-built mesh (tests / multi-axis setups).
+    """
+
+    def __init__(
+        self,
+        model: Union[CompiledModel, dict],
+        mode: str = "asynchronous",
+        frequency: str = "epoch",
+        parameter_server_mode: str = "local",
+        num_workers: Optional[int] = None,
+        port: int = 4000,
+        custom_objects: Optional[dict] = None,
+        batch_size: int = 32,
+        mesh=None,
+    ):
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        if frequency not in FREQUENCIES:
+            raise ValueError(f"frequency must be one of {FREQUENCIES}, got {frequency!r}")
+        if isinstance(model, dict):
+            from elephas_tpu.serialize.serialization import dict_to_model
+
+            model = dict_to_model(model, custom_objects)
+        if not isinstance(model, CompiledModel):
+            raise TypeError(
+                "model must be a CompiledModel (or a model_to_dict payload); "
+                "wrap flax modules with elephas_tpu.compile_model"
+            )
+        self._master = model
+        self.mode = mode
+        self.frequency = frequency
+        self.parameter_server_mode = parameter_server_mode
+        self.port = port
+        self.custom_objects = custom_objects or {}
+        self.batch_size = batch_size
+
+        n_devices = len(jax.devices())
+        if num_workers is None:
+            num_workers = n_devices
+        if num_workers > n_devices:
+            logger.warning(
+                "num_workers=%d exceeds device count %d; capping (one worker per chip)",
+                num_workers,
+                n_devices,
+            )
+            num_workers = n_devices
+        self.num_workers = num_workers
+        self._mesh = mesh
+        self._state = None  # latest TrainState (post-fit)
+        self.training_histories: List[Dict[str, List[float]]] = []
+
+    # -- reference surface -----------------------------------------------------
+
+    @property
+    def master_network(self) -> CompiledModel:
+        return self._master
+
+    @master_network.setter
+    def master_network(self, model: CompiledModel) -> None:
+        self._master = model
+        self._state = None
+
+    def get_weights(self):
+        return self._master.get_weights()
+
+    def set_weights(self, params) -> None:
+        self._master.set_weights(params)
+        self._state = None
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = build_mesh(num_data=self.num_workers)
+        return self._mesh
+
+    def _as_dataset(self, data, batch_size: int) -> ShardedDataset:
+        if isinstance(data, ShardedDataset):
+            if data.num_partitions != self.num_workers:
+                data = data.repartition(self.num_workers)
+            return data
+        if isinstance(data, tuple) and len(data) == 2:
+            return ShardedDataset(data[0], data[1], self.num_workers)
+        if isinstance(data, np.ndarray):
+            return ShardedDataset(data, None, self.num_workers)
+        raise TypeError(f"cannot interpret training data of type {type(data)}")
+
+    def fit(
+        self,
+        rdd,
+        epochs: int = 10,
+        batch_size: Optional[int] = None,
+        verbose: int = 0,
+        validation_split: float = 0.0,
+        validation_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    ) -> Dict[str, List[float]]:
+        """Train on a ShardedDataset (or ``(x, y)``), reference §3.1/§3.2."""
+        batch_size = batch_size or self.batch_size
+        dataset = self._as_dataset(rdd, batch_size)
+        if dataset.labels is None:
+            raise ValueError("fit needs labels")
+
+        if validation_data is None and validation_split > 0:
+            n_val = int(len(dataset) * validation_split)
+            if n_val:
+                validation_data = (
+                    dataset.features[-n_val:],
+                    dataset.labels[-n_val:],
+                )
+                dataset = ShardedDataset(
+                    dataset.features[:-n_val],
+                    dataset.labels[:-n_val],
+                    dataset.num_partitions,
+                )
+
+        if self.mode == "synchronous":
+            trainer = SyncTrainer(self._master, self.mesh, frequency=self.frequency)
+            state, history = trainer.fit(
+                dataset,
+                epochs=epochs,
+                batch_size=batch_size,
+                validation_data=validation_data,
+                verbose=verbose,
+            )
+            self._sync_trainer = trainer
+        else:
+            from elephas_tpu.engine.async_engine import AsyncTrainer
+
+            trainer = AsyncTrainer(
+                self._master,
+                self.mesh,
+                frequency=self.frequency,
+                lock=(self.mode == "asynchronous"),
+                parameter_server_mode=self.parameter_server_mode,
+                port=self.port,
+            )
+            state, history = trainer.fit(
+                dataset,
+                epochs=epochs,
+                batch_size=batch_size,
+                validation_data=validation_data,
+                verbose=verbose,
+            )
+            self._sync_trainer = None
+
+        # Fold the trained weights back into the master network
+        # (reference: master_network.set_weights after collect/PS stop).
+        self._state = state
+        self._master.params = jax.device_get(state.params)
+        self._master.batch_stats = jax.device_get(state.batch_stats)
+        self.training_histories.append(history)
+        return history
+
+    def _eval_trainer(self) -> SyncTrainer:
+        # Evaluation/prediction always uses the SPMD path regardless of
+        # training mode (reference predict/evaluate broadcast+mapPartitions).
+        trainer = getattr(self, "_sync_trainer", None)
+        if trainer is None:
+            trainer = SyncTrainer(self._master, self.mesh, frequency="batch")
+            self._sync_trainer = trainer
+        return trainer
+
+    def _current_state(self):
+        if self._state is None:
+            self._state = init_train_state(self._master)
+        return self._state
+
+    def predict(self, data, batch_size: int = 256) -> np.ndarray:
+        """Distributed inference (reference §3.5)."""
+        if isinstance(data, ShardedDataset):
+            features = data.features
+        else:
+            features = np.asarray(data)
+        return self._eval_trainer().predict_state(
+            self._current_state(), features, batch_size=batch_size
+        )
+
+    def evaluate(self, x, y=None, batch_size: int = 256) -> Dict[str, float]:
+        """Distributed evaluation; returns a metrics dict (loss + compiled
+        metrics), the reference's weighted-average semantics (§3.5)."""
+        if isinstance(x, ShardedDataset):
+            features, labels = x.features, x.labels
+        else:
+            features, labels = np.asarray(x), np.asarray(y)
+        return self._eval_trainer().evaluate_state(
+            self._current_state(), features, labels, batch_size=batch_size
+        )
+
+    def save(self, path: str) -> None:
+        """Persist the master network (arch + weights + optimizer config).
+
+        The reference writes Keras HDF5; the rebuild writes a pickled
+        ``model_to_dict`` payload (portable, dependency-free). Use
+        ``elephas_tpu.checkpoint`` for mid-training snapshots with
+        optimizer state.
+        """
+        from elephas_tpu.serialize.serialization import model_to_dict
+
+        payload = {
+            "model": model_to_dict(self._master),
+            "mode": self.mode,
+            "frequency": self.frequency,
+            "parameter_server_mode": self.parameter_server_mode,
+            "num_workers": self.num_workers,
+            "batch_size": self.batch_size,
+            "port": self.port,
+        }
+        with open(path, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+# Reference alias: user code says ``SparkModel``.
+SparkModel = TpuModel
+
+
+def load_spark_model(path: str, custom_objects: Optional[dict] = None) -> TpuModel:
+    """Inverse of ``SparkModel.save`` (reference ``load_spark_model``)."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    from elephas_tpu.serialize.serialization import dict_to_model
+
+    model = dict_to_model(payload["model"], custom_objects)
+    return TpuModel(
+        model,
+        mode=payload["mode"],
+        frequency=payload["frequency"],
+        parameter_server_mode=payload["parameter_server_mode"],
+        num_workers=payload["num_workers"],
+        batch_size=payload["batch_size"],
+        port=payload["port"],
+    )
+
+
+class SparkMLlibModel(TpuModel):
+    """LabeledPoint-RDD façade (reference ``SparkMLlibModel``, SURVEY.md §0)."""
+
+    def fit(
+        self,
+        labeled_points,
+        epochs: int = 10,
+        batch_size: Optional[int] = None,
+        verbose: int = 0,
+        validation_split: float = 0.0,
+        categorical: bool = False,
+        nb_classes: Optional[int] = None,
+    ):
+        dataset = lp_to_simple_rdd(
+            labeled_points,
+            categorical=categorical,
+            nb_classes=nb_classes,
+            num_partitions=self.num_workers,
+        )
+        return super().fit(
+            dataset,
+            epochs=epochs,
+            batch_size=batch_size,
+            verbose=verbose,
+            validation_split=validation_split,
+        )

@@ -1,0 +1,17 @@
+"""Data layer: device-sharded datasets, columnar DataFrame, adapters.
+
+Replaces the reference's Spark RDD/DataFrame ingestion (SURVEY.md §2.1:
+``elephas/utils/rdd_utils.py``, ``elephas/ml/adapter.py``,
+``elephas/mllib/adapter.py``).
+"""
+
+from elephas_tpu.data.rdd import (  # noqa: F401
+    LabeledPoint,
+    ShardedDataset,
+    encode_label,
+    from_labeled_point,
+    lp_to_simple_rdd,
+    to_labeled_point,
+    to_simple_rdd,
+)
+from elephas_tpu.data.dataframe import DataFrame  # noqa: F401

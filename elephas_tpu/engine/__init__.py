@@ -1,0 +1,7 @@
+"""Execution engine: jitted steps, mode drivers (sync / async / hogwild).
+
+Replaces the reference's worker runtime + parameter exchange layers
+(SURVEY.md §1 L3+L2): where the reference ships pickled closures into
+Spark ``mapPartitions`` and moves weights over HTTP, this engine compiles
+SPMD train steps over a device mesh and moves gradients over ICI.
+"""

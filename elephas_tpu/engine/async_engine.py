@@ -1,0 +1,227 @@
+"""Asynchronous / hogwild trainer (Downpour SGD on chips).
+
+Reference semantics (SURVEY.md §3.2): each ``AsynchronousSparkWorker``
+loops pull -> train one ``frequency`` unit ('epoch' or 'batch') -> push
+delta against the driver's parameter server; ``asynchronous`` locks the
+server state, ``hogwild`` doesn't.
+
+TPU-native redesign (SURVEY.md §7 hard part 1): XLA wants lockstep SPMD,
+Downpour wants divergent per-chip programs — so each worker is a *host
+thread* driving independently-jitted steps on its own chip, and the
+parameter server is an HBM-resident ``ParameterBuffer``. A pull is a
+device-to-device copy, a push is an on-device subtract; with the
+``http``/``socket`` transports the same loop spans hosts. Host work per
+round is a dispatch + two small transfers, so the GIL stays out of the
+hot path and chip queues run ahead.
+
+Worker-local optimizer state persists across rounds (Downpour keeps
+worker optimizers; only weights flow through the server — matching the
+reference, where the driver averages weights, never optimizer slots).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elephas_tpu.engine.state import TrainState
+from elephas_tpu.engine.step import make_epoch_scanner, make_train_step
+from elephas_tpu.parallel.mesh import DATA_AXIS
+from elephas_tpu.parameter.server import make_server
+from elephas_tpu.utils.functional_utils import subtract_params
+
+_FREQUENCIES = ("batch", "epoch")
+
+
+class AsyncTrainer:
+    def __init__(
+        self,
+        compiled,
+        mesh,
+        frequency: str = "epoch",
+        lock: bool = True,
+        parameter_server_mode: str = "local",
+        port: int = 4000,
+    ):
+        if frequency not in _FREQUENCIES:
+            raise ValueError(
+                f"async frequency must be batch|epoch, got {frequency!r} "
+                "(the reference's AsynchronousSparkWorker supports the same two)"
+            )
+        self.compiled = compiled
+        self.mesh = mesh
+        self.frequency = frequency
+        self.lock = lock
+        self.parameter_server_mode = parameter_server_mode
+        self.port = port
+        # One worker per device along the data axis.
+        n_data = mesh.shape[DATA_AXIS]
+        self.devices = list(np.asarray(mesh.devices).reshape(mesh.devices.shape[0], -1)[:, 0][:n_data])
+        self.n_workers = len(self.devices)
+        self._train_step = make_train_step(compiled)
+        self._subtract = jax.jit(subtract_params)
+        self._epoch_fn = jax.jit(make_epoch_scanner(self._train_step))
+        self._step_fn = jax.jit(self._train_step)
+        # Distinct, collision-free per-worker/per-step dropout streams.
+        self._base_rng = jax.random.PRNGKey(977)
+
+    # -------------------------------------------------------------------------
+
+    def fit(
+        self,
+        dataset,
+        epochs: int = 10,
+        batch_size: int = 32,
+        validation_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        verbose: int = 0,
+        rng: Optional[jax.Array] = None,
+    ) -> Tuple[TrainState, Dict[str, List[float]]]:
+        compiled = self.compiled
+        store0 = {"params": compiled.params, "batch_stats": compiled.batch_stats}
+        server = make_server(
+            self.parameter_server_mode,
+            store0,
+            lock=self.lock,
+            port=self.port,
+            device=jax.devices()[0],
+        )
+        server.start()
+
+        per_worker_metrics: List[List[Dict[str, float]]] = [None] * self.n_workers
+        errors: List[BaseException] = []
+
+        def worker(index: int, device: jax.Device) -> None:
+            try:
+                per_worker_metrics[index] = self._run_worker(
+                    index, device, server, dataset, epochs, batch_size
+                )
+            except BaseException as exc:  # surfaced after join
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=worker, args=(i, dev), daemon=True)
+            for i, dev in enumerate(self.devices)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        final = jax.device_get(server.get_parameters())
+        server.stop()
+        if errors:
+            raise errors[0]
+
+        # Master state from the server's final weights; metrics averaged
+        # across workers per epoch.
+        state = TrainState.create(
+            params=final["params"],
+            opt_state=compiled.init_opt_state(final["params"]),
+            batch_stats=final["batch_stats"],
+            rng=rng if rng is not None else jax.random.PRNGKey(0),
+        )
+        history: Dict[str, List[float]] = {}
+        for epoch in range(epochs):
+            epoch_dicts = [m[epoch] for m in per_worker_metrics if m is not None]
+            for key in epoch_dicts[0]:
+                history.setdefault(key, []).append(
+                    float(np.mean([d[key] for d in epoch_dicts]))
+                )
+        if validation_data is not None:
+            from elephas_tpu.engine.sync import SyncTrainer
+
+            val = SyncTrainer(compiled, self.mesh, frequency="batch").evaluate_state(
+                state, *validation_data
+            )
+            for k, v in val.items():
+                history.setdefault(f"val_{k}", []).append(v)
+        if verbose:
+            last = {k: round(v[-1], 4) for k, v in history.items()}
+            print(f"[{'async' if self.lock else 'hogwild'}] done: {last}")
+        return state, history
+
+    # -------------------------------------------------------------------------
+
+    def _run_worker(
+        self, index: int, device: jax.Device, server, dataset, epochs: int, batch_size: int
+    ) -> List[Dict[str, float]]:
+        compiled = self.compiled
+        client = server.client()
+        x, y = dataset.partition(index)
+        nb = len(x) // batch_size
+        if nb == 0:
+            raise ValueError(
+                f"worker {index}: partition of {len(x)} rows < batch_size {batch_size}"
+            )
+        usable = nb * batch_size
+        x, y = np.asarray(x[:usable]), np.asarray(y[:usable])
+
+        rng_np = np.random.default_rng(1234 + index)
+        opt_state = None
+        epoch_metrics: List[Dict[str, float]] = []
+
+        def pull_state(step: int) -> TrainState:
+            nonlocal opt_state
+            pulled = client.get_parameters()
+            params = jax.device_put(pulled["params"], device)
+            batch_stats = jax.device_put(pulled["batch_stats"], device)
+            if opt_state is None:
+                opt_state = jax.device_put(compiled.init_opt_state(params), device)
+            rng = jax.random.fold_in(jax.random.fold_in(self._base_rng, index), step)
+            return TrainState.create(
+                params=params,
+                opt_state=opt_state,
+                batch_stats=batch_stats,
+                rng=jax.device_put(rng, device),
+                step=step,
+            )
+
+        def push_delta(before: TrainState, after: TrainState) -> None:
+            delta = {
+                "params": self._subtract(before.params, after.params),
+                "batch_stats": self._subtract(before.batch_stats, after.batch_stats),
+            }
+            client.update_parameters(delta)
+
+        global_step = 0
+        for epoch in range(epochs):
+            perm = rng_np.permutation(usable)
+            ex = x[perm].reshape(nb, batch_size, *x.shape[1:])
+            ey = y[perm].reshape(nb, batch_size, *y.shape[1:])
+            if self.frequency == "epoch":
+                ex_d = jax.device_put(ex, device)
+                ey_d = jax.device_put(ey, device)
+                state = pull_state(global_step)
+                new_state, metrics = self._epoch_fn(state, ex_d, ey_d)
+                push_delta(state, new_state)
+                opt_state = new_state.opt_state
+                global_step += nb
+                epoch_metrics.append(
+                    {k: float(v) for k, v in jax.device_get(metrics).items()}
+                )
+            else:  # frequency == 'batch': pull/push every step (reference cadence)
+                batch_dicts = []
+                for b in range(nb):
+                    xb = jax.device_put(ex[b], device)
+                    yb = jax.device_put(ey[b], device)
+                    state = pull_state(global_step)
+                    new_state, metrics = self._step_fn(state, xb, yb)
+                    push_delta(state, new_state)
+                    opt_state = new_state.opt_state
+                    global_step += 1
+                    batch_dicts.append(
+                        {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    )
+                epoch_metrics.append(
+                    {
+                        k: float(np.mean([d[k] for d in batch_dicts]))
+                        for k in batch_dicts[0]
+                    }
+                )
+        if hasattr(client, "close"):
+            client.close()
+        return epoch_metrics

@@ -1,0 +1,99 @@
+"""Named losses and metrics (Keras-compatible string identifiers).
+
+The reference passes Keras loss/metric names through to workers
+(``master_loss``, ``master_metrics`` on ``elephas/worker.py::SparkWorker``,
+SURVEY.md §2.1). The rebuild resolves the same names to pure JAX functions
+usable inside jitted steps. All losses take ``(logits_or_preds, targets)``
+batched and return per-example losses; reduction happens in the step so
+that global-batch means are exact under sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax.numpy as jnp
+import optax
+
+
+def _categorical_crossentropy(logits, targets):
+    """One-hot targets, logits in; softmax cross-entropy."""
+    return optax.softmax_cross_entropy(logits, targets)
+
+
+def _sparse_categorical_crossentropy(logits, targets):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, targets.astype(jnp.int32)
+    )
+
+
+def _binary_crossentropy(logits, targets):
+    """Sigmoid cross-entropy on logits; targets in {0,1} (any shape)."""
+    losses = optax.sigmoid_binary_cross_entropy(logits, targets)
+    return losses.reshape(losses.shape[0], -1).mean(axis=-1)
+
+
+def _mse(preds, targets):
+    err = jnp.square(preds - targets)
+    return err.reshape(err.shape[0], -1).mean(axis=-1)
+
+
+def _mae(preds, targets):
+    err = jnp.abs(preds - targets)
+    return err.reshape(err.shape[0], -1).mean(axis=-1)
+
+
+LOSSES: Dict[str, Callable] = {
+    "categorical_crossentropy": _categorical_crossentropy,
+    "sparse_categorical_crossentropy": _sparse_categorical_crossentropy,
+    "binary_crossentropy": _binary_crossentropy,
+    "mse": _mse,
+    "mean_squared_error": _mse,
+    "mae": _mae,
+    "mean_absolute_error": _mae,
+}
+
+
+def resolve_loss(loss) -> Callable:
+    if callable(loss):
+        return loss
+    try:
+        return LOSSES[loss]
+    except KeyError:
+        raise ValueError(f"unknown loss {loss!r}; known: {sorted(LOSSES)}") from None
+
+
+def _accuracy(logits, targets):
+    """Works for one-hot or integer targets (categorical accuracy)."""
+    pred = jnp.argmax(logits, axis=-1)
+    if targets.ndim == logits.ndim:  # one-hot
+        true = jnp.argmax(targets, axis=-1)
+    else:
+        true = targets.astype(pred.dtype)
+    return (pred == true).astype(jnp.float32)
+
+
+def _binary_accuracy(logits, targets):
+    pred = (logits > 0).astype(jnp.float32)  # logits: sigmoid(0.0) == 0.5
+    acc = (pred == targets).astype(jnp.float32)
+    return acc.reshape(acc.shape[0], -1).mean(axis=-1)
+
+
+METRICS: Dict[str, Callable] = {
+    "acc": _accuracy,
+    "accuracy": _accuracy,
+    "categorical_accuracy": _accuracy,
+    "sparse_categorical_accuracy": _accuracy,
+    "binary_accuracy": _binary_accuracy,
+    "mae": _mae,
+    "mse": _mse,
+}
+
+
+def resolve_metric(metric) -> Callable:
+    if callable(metric):
+        return metric
+    try:
+        return METRICS[metric]
+    except KeyError:
+        raise ValueError(f"unknown metric {metric!r}; known: {sorted(METRICS)}") from None

@@ -1,0 +1,39 @@
+"""Train state pytree.
+
+The reference has no train state: each ``SparkWorker`` rebuilds a Keras
+model from the broadcast dict and Keras hides weights/optimizer slots
+inside the model object (SURVEY.md §3.1). TPU-native training is
+functional, so state is an explicit pytree that jit/shard_map/donation can
+see: params, mutable collections (BatchNorm stats), optimizer state, step
+counter, PRNG key.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    batch_stats: Any  # {} for models without BatchNorm
+    opt_state: Any
+    rng: jax.Array
+
+    @classmethod
+    def create(cls, params, opt_state, batch_stats=None, rng=None, step=0):
+        if batch_stats is None:
+            batch_stats = {}
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return cls(
+            step=jnp.asarray(step, dtype=jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=opt_state,
+            rng=rng,
+        )

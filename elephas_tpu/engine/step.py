@@ -1,0 +1,134 @@
+"""Jitted train/eval/predict step builders.
+
+This is the rebuild of the reference's per-worker compute: where
+``elephas/worker.py::SparkWorker.train`` calls Keras ``model.fit`` on TF
+kernels (SURVEY.md §3.1 [HOT]), here a pure function closes over the
+``CompiledModel``'s apply/loss/optimizer and is compiled once by XLA.
+The same step function serves every mode:
+
+- sync: jitted over the mesh with the batch sharded on ``'data'`` —
+  GSPMD inserts the gradient allreduce (``psum``) automatically since the
+  loss is a global-batch mean;
+- async/hogwild: jitted per-device, driven by host threads;
+- single-chip: plain jit.
+
+Losses are computed in f32 regardless of compute dtype; per-example loss
+vectors are meaned so sharded means are exact when shard sizes are equal
+(guaranteed by ``ShardedDataset.even_shards``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from elephas_tpu.engine.state import TrainState
+
+
+def make_loss_fn(compiled) -> Callable:
+    """(params, batch_stats, x, y, rng) -> (loss, (new_batch_stats, outputs))."""
+
+    def loss_fn(params, batch_stats, x, y, rng):
+        outputs, new_stats = compiled.apply_train(params, batch_stats, x, rng)
+        per_example = compiled.loss_fn(outputs.astype(jnp.float32), y)
+        return per_example.mean(), (new_stats, outputs)
+
+    return loss_fn
+
+
+def _metrics_dict(compiled, loss, outputs, y) -> Dict[str, jax.Array]:
+    metrics = {"loss": loss}
+    for name, fn in zip(compiled.metric_names, compiled.metric_fns):
+        metrics[name] = fn(outputs.astype(jnp.float32), y).mean()
+    return metrics
+
+
+def make_train_step(compiled, pmean_axis: Optional[str] = None) -> Callable:
+    """Build ``step(state, x, y) -> (new_state, metrics)`` (uncompiled).
+
+    ``pmean_axis``: if set, gradients and metrics are ``lax.pmean``'d over
+    that mesh axis before the optimizer update — the per-step allreduce
+    that replaces the reference's driver ``collect()`` in lockstep DP.
+    """
+    loss_fn = make_loss_fn(compiled)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def train_step(state: TrainState, x, y) -> Tuple[TrainState, Dict]:
+        rng, step_rng = jax.random.split(state.rng)
+        (loss, (new_stats, outputs)), grads = grad_fn(
+            state.params, state.batch_stats, x, y, step_rng
+        )
+        if pmean_axis is not None:
+            grads = jax.lax.pmean(grads, pmean_axis)
+        updates, new_opt_state = compiled.optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), state.params, updates
+        )
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+            rng=rng,
+        )
+        metrics = _metrics_dict(compiled, loss, outputs, y)
+        if pmean_axis is not None:
+            metrics = jax.tree_util.tree_map(
+                lambda m: jax.lax.pmean(m, pmean_axis), metrics
+            )
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(compiled) -> Callable:
+    """Build ``eval_step(state, x, y) -> metrics`` (deterministic)."""
+
+    def eval_step(state: TrainState, x, y) -> Dict[str, jax.Array]:
+        outputs = compiled.apply_eval(state.params, state.batch_stats, x)
+        loss = compiled.loss_fn(outputs.astype(jnp.float32), y).mean()
+        return _metrics_dict(compiled, loss, outputs, y)
+
+    return eval_step
+
+
+def make_predict_step(compiled) -> Callable:
+    def predict_step(state: TrainState, x):
+        return compiled.apply_eval(state.params, state.batch_stats, x)
+
+    return predict_step
+
+
+def make_epoch_scanner(train_step: Callable) -> Callable:
+    """Build ``scan_epoch(state, xs, ys) -> (state, mean_metrics)``.
+
+    xs/ys are (num_batches, batch, ...) stacks; the whole epoch runs as a
+    single ``lax.scan`` inside one compiled program — no per-batch Python
+    dispatch (the reference pays a network round-trip per batch in async
+    mode; we don't even pay a host round-trip).
+    """
+
+    def scan_epoch(state: TrainState, xs, ys):
+        def body(carry, batch):
+            x, y = batch
+            new_state, metrics = train_step(carry, x, y)
+            return new_state, metrics
+
+        state, metrics = jax.lax.scan(body, state, (xs, ys))
+        return state, jax.tree_util.tree_map(lambda m: m.mean(), metrics)
+
+    return scan_epoch
+
+
+def init_train_state(compiled, rng=None) -> TrainState:
+    """Fresh TrainState from a CompiledModel's current weights."""
+    return TrainState.create(
+        params=compiled.params,
+        opt_state=compiled.init_opt_state(),
+        batch_stats=compiled.batch_stats,
+        rng=rng if rng is not None else jax.random.PRNGKey(0),
+    )

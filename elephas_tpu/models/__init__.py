@@ -1,0 +1,51 @@
+"""Model zoo + registry.
+
+The reference ships no models — users hand it compiled Keras models, and
+its examples build MNIST MLP/CNN, IMDB LSTM, CIFAR ResNet (BASELINE.md
+configs). The rebuild provides those architectures as flax modules so the
+five benchmark configs are runnable out of the box, plus a *registry* so
+architectures serialize by name (the TPU-native analogue of Keras's
+``model_to_json`` arch string — SURVEY.md §2.1 serialization row).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str):
+    """Register a module builder under ``name`` for arch serialization."""
+
+    def wrap(builder: Callable) -> Callable:
+        _REGISTRY[name] = builder
+        return wrap.__wrapped__ if hasattr(wrap, "__wrapped__") else builder
+
+    return wrap
+
+
+def get_model(name: str, **kwargs):
+    """Build a registered module; tags it so its arch serializes by name."""
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    module = _REGISTRY[name](**kwargs)
+    config = {"name": name, "kwargs": kwargs}
+    try:
+        object.__setattr__(module, "_elephas_config", config)
+    except AttributeError:  # exotic Module subclass with __slots__
+        pass
+    return module
+
+
+def registered_models():
+    return sorted(_REGISTRY)
+
+
+# Import for side effect: populate the registry.
+from elephas_tpu.models import mlp, cnn, resnet, lstm, transformer  # noqa: E402,F401
+from elephas_tpu.models.mlp import MLP  # noqa: E402,F401
+from elephas_tpu.models.cnn import SimpleCNN  # noqa: E402,F401
+from elephas_tpu.models.resnet import ResNet18  # noqa: E402,F401
+from elephas_tpu.models.lstm import LSTMClassifier  # noqa: E402,F401
+from elephas_tpu.models.transformer import TransformerLM  # noqa: E402,F401

@@ -1,0 +1,41 @@
+"""Small convnet (BASELINE config 2: MNIST CNN via async mode)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+from elephas_tpu.models import register_model
+
+
+class SimpleCNN(nn.Module):
+    """Conv-pool stack + dense head; NHWC inputs; logits out."""
+
+    channels: Sequence[int] = (32, 64)
+    dense_width: int = 128
+    num_classes: int = 10
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for ch in self.channels:
+            x = nn.Conv(ch, kernel_size=(3, 3), padding="SAME")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.dense_width)(x)
+        x = nn.relu(x)
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("cnn")
+def build_cnn(channels=(32, 64), dense_width=128, num_classes=10, dropout_rate=0.0):
+    return SimpleCNN(
+        channels=tuple(channels),
+        dense_width=dense_width,
+        num_classes=num_classes,
+        dropout_rate=dropout_rate,
+    )

@@ -1,0 +1,42 @@
+"""LSTM text classifier (BASELINE config 3: IMDB LSTM via the estimator).
+
+TPU-first: the recurrence is a single ``nn.RNN``/``lax.scan`` over the
+sequence (static shapes, compiler-friendly control flow) — no Python loop,
+no dynamic lengths inside jit. Inputs are int32 token ids, right-padded.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from elephas_tpu.models import register_model
+
+
+class LSTMClassifier(nn.Module):
+    vocab_size: int = 20000
+    embed_dim: int = 128
+    hidden_dim: int = 128
+    num_classes: int = 2
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embed_dim)(tokens.astype(jnp.int32))
+        rnn = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim))
+        x = rnn(x)  # (batch, seq, hidden)
+        x = x[:, -1, :]  # final state
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("lstm")
+def build_lstm(vocab_size=20000, embed_dim=128, hidden_dim=128, num_classes=2, dropout_rate=0.0):
+    return LSTMClassifier(
+        vocab_size=vocab_size,
+        embed_dim=embed_dim,
+        hidden_dim=hidden_dim,
+        num_classes=num_classes,
+        dropout_rate=dropout_rate,
+    )

@@ -1,0 +1,33 @@
+"""MLP classifier (BASELINE config 1: MNIST MLP, SURVEY.md §6)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+from elephas_tpu.models import register_model
+
+
+class MLP(nn.Module):
+    """Dense stack with ReLU + dropout, logits out (no softmax — losses
+    expect logits)."""
+
+    features: Sequence[int] = (128, 128)
+    num_classes: int = 10
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        for width in self.features:
+            x = nn.Dense(width)(x)
+            x = nn.relu(x)
+            if self.dropout_rate > 0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("mlp")
+def build_mlp(features=(128, 128), num_classes=10, dropout_rate=0.0):
+    return MLP(features=tuple(features), num_classes=num_classes, dropout_rate=dropout_rate)

@@ -1,0 +1,99 @@
+"""ResNet-18 (BASELINE config 4 / primary benchmark: CIFAR-10 hogwild).
+
+TPU-first choices: NHWC layout (native for TPU convs), optional bfloat16
+compute with float32 parameters/statistics (MXU-friendly mixed precision),
+CIFAR-style 3x3 stem by default (the benchmark is CIFAR-10; ImageNet-style
+7x7 stem + maxpool available via ``imagenet_stem=True``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from elephas_tpu.models import register_model
+
+
+class ResidualBlock(nn.Module):
+    channels: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,  # keep statistics in f32 even under bf16 compute
+        )
+        residual = x
+        y = conv(self.channels, (3, 3), strides=(self.strides, self.strides), padding="SAME")(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.channels, (3, 3), padding="SAME")(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.channels, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)  # ResNet-18
+    num_classes: int = 10
+    width: int = 64
+    dtype: Any = jnp.float32
+    imagenet_stem: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        if self.imagenet_stem:
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype)(x)
+        else:
+            x = nn.Conv(self.width, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                         dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        if self.imagenet_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            channels = self.width * (2**stage)
+            for block in range(num_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = ResidualBlock(channels, strides=strides, dtype=self.dtype)(
+                    x, train=train
+                )
+        x = jnp.mean(x, axis=(1, 2))
+        # Head in f32 for numerically-stable softmax.
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+def ResNet18(num_classes: int = 10, width: int = 64, dtype=jnp.float32, imagenet_stem=False):
+    return ResNet(
+        stage_sizes=(2, 2, 2, 2),
+        num_classes=num_classes,
+        width=width,
+        dtype=dtype,
+        imagenet_stem=imagenet_stem,
+    )
+
+
+@register_model("resnet18")
+def build_resnet18(num_classes=10, width=64, dtype="float32", imagenet_stem=False):
+    return ResNet18(
+        num_classes=num_classes,
+        width=width,
+        dtype=jnp.dtype(dtype),
+        imagenet_stem=imagenet_stem,
+    )

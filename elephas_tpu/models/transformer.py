@@ -1,0 +1,124 @@
+"""Decoder-only transformer LM — the long-context flagship.
+
+Not present in the reference (SURVEY.md §5.7: no long-context support
+anywhere in elephas); included because long sequences are first-class in
+the TPU rebuild. The attention implementation is pluggable:
+
+- ``attention='dense'`` — plain softmax attention (XLA-fused),
+- ``attention='flash'`` — Pallas blockwise kernel (``elephas_tpu.ops``),
+- sequence parallelism over a ``'seq'`` mesh axis is provided by
+  ``elephas_tpu.parallel.ring_attention`` at the engine level.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from elephas_tpu.models import register_model
+
+
+def dense_causal_attention(q, k, v):
+    """Reference softmax attention. q/k/v: (batch, heads, seq, head_dim)."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    seq = q.shape[2]
+    mask = jnp.tril(jnp.ones((seq, seq), dtype=bool))
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    weights = nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+class SelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.float32
+    attention: str = "dense"
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        qkv = nn.DenseGeneral((3, self.num_heads, head_dim), dtype=self.dtype,
+                              name="qkv")(x)
+        q, k, v = jnp.moveaxis(qkv, -3, 0)  # each (batch, seq, heads, head_dim)
+        q = jnp.transpose(q, (0, 2, 1, 3))
+        k = jnp.transpose(k, (0, 2, 1, 3))
+        v = jnp.transpose(v, (0, 2, 1, 3))
+        if self.attention == "flash":
+            from elephas_tpu.ops.attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = dense_causal_attention(q, k, v)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(x.shape[0], x.shape[1], d_model)
+        return nn.DenseGeneral(d_model, dtype=self.dtype, name="out")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+    attention: str = "dense"
+
+    @nn.compact
+    def __call__(self, x):
+        d_model = x.shape[-1]
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = x + SelfAttention(self.num_heads, dtype=self.dtype,
+                              attention=self.attention)(y)
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.Dense(d_model * self.mlp_ratio, dtype=self.dtype)(y)
+        h = nn.gelu(h)
+        return x + nn.Dense(d_model, dtype=self.dtype)(h)
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 32000
+    d_model: int = 256
+    num_heads: int = 8
+    num_layers: int = 4
+    max_seq_len: int = 2048
+    dtype: Any = jnp.float32
+    attention: str = "dense"
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        seq = tokens.shape[1]
+        x = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(
+            tokens.astype(jnp.int32)
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_seq_len, self.d_model),
+        )
+        x = (x + pos[:seq]).astype(self.dtype)
+        for _ in range(self.num_layers):
+            x = Block(self.num_heads, dtype=self.dtype, attention=self.attention)(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x.astype(jnp.float32))
+        # Next-token logits, tied head kept separate for simplicity.
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
+
+
+@register_model("transformer_lm")
+def build_transformer_lm(
+    vocab_size=32000,
+    d_model=256,
+    num_heads=8,
+    num_layers=4,
+    max_seq_len=2048,
+    dtype="float32",
+    attention="dense",
+):
+    return TransformerLM(
+        vocab_size=vocab_size,
+        d_model=d_model,
+        num_heads=num_heads,
+        num_layers=num_layers,
+        max_seq_len=max_seq_len,
+        dtype=jnp.dtype(dtype),
+        attention=attention,
+    )

@@ -1,0 +1,6 @@
+"""Custom TPU ops (Pallas kernels with XLA fallbacks).
+
+The reference has no op layer — TF kernels are L0 borrowing (SURVEY.md
+§1). Here the hot ops the compiler can't already fuse optimally get
+hand-written Pallas kernels, with pure-XLA fallbacks for CPU tests.
+"""

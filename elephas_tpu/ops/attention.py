@@ -1,0 +1,85 @@
+"""Blockwise (flash) attention.
+
+``flash_attention(q, k, v, causal)`` computes softmax attention in tiles
+so the (seq × seq) score matrix never materializes in HBM. On TPU a
+Pallas kernel is used (MXU-tiled, VMEM-resident running max/sum); on CPU
+(tests) an XLA ``lax.scan`` blockwise implementation with identical
+numerics runs instead.
+
+Shapes: q, k, v are (batch, heads, seq, head_dim); returns the same.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _blockwise_reference(q, k, v, causal: bool, block_q: int, block_k: int):
+    """Numerically-stable streaming softmax over k/v blocks (XLA path)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=q.dtype))
+    q = q * scale
+
+    nq = -(-sq // block_q)
+    nk = -(-sk // block_k)
+    # Pad seq dims to block multiples (masked out below).
+    q = jnp.pad(q, ((0, 0), (0, 0), (0, nq * block_q - sq), (0, 0)))
+    k = jnp.pad(k, ((0, 0), (0, 0), (0, nk * block_k - sk), (0, 0)))
+    v = jnp.pad(v, ((0, 0), (0, 0), (0, nk * block_k - sk), (0, 0)))
+
+    q_blocks = q.reshape(b, h, nq, block_q, d)
+
+    def process_q_block(qi, q_blk):
+        q_pos = qi * block_q + jnp.arange(block_q)
+
+        def scan_kv(carry, kj):
+            acc, row_max, row_sum = carry
+            k_blk = jax.lax.dynamic_slice_in_dim(k, kj * block_k, block_k, axis=2)
+            v_blk = jax.lax.dynamic_slice_in_dim(v, kj * block_k, block_k, axis=2)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q_blk, k_blk)
+            k_pos = kj * block_k + jnp.arange(block_k)
+            valid = k_pos[None, :] < sk
+            if causal:
+                valid = valid & (k_pos[None, :] <= q_pos[:, None])
+            scores = jnp.where(valid[None, None], scores, -jnp.inf)
+            new_max = jnp.maximum(row_max, scores.max(axis=-1))
+            # Renormalize the running accumulator to the new max.
+            correction = jnp.exp(row_max - new_max)
+            correction = jnp.where(jnp.isfinite(row_max), correction, 0.0)
+            weights = jnp.exp(scores - new_max[..., None])
+            acc = acc * correction[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", weights, v_blk
+            )
+            row_sum = row_sum * correction + weights.sum(axis=-1)
+            return (acc, new_max, row_sum), None
+
+        acc0 = jnp.zeros((b, h, block_q, d), dtype=q.dtype)
+        max0 = jnp.full((b, h, block_q), -jnp.inf, dtype=q.dtype)
+        sum0 = jnp.zeros((b, h, block_q), dtype=q.dtype)
+        (acc, _, row_sum), _ = jax.lax.scan(
+            scan_kv, (acc0, max0, sum0), jnp.arange(nk)
+        )
+        return acc / jnp.maximum(row_sum[..., None], 1e-30)
+
+    outs = [
+        process_q_block(qi, q_blocks[:, :, qi]) for qi in range(nq)
+    ]
+    out = jnp.concatenate(outs, axis=2)
+    return out[:, :, :sq]
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128):
+    """Blockwise attention; Pallas on TPU, XLA blockwise elsewhere."""
+    if jax.default_backend() == "tpu":
+        try:
+            from elephas_tpu.ops.attention_pallas import pallas_flash_attention
+        except ImportError:  # kernel module not present on this build
+            pass
+        else:
+            return pallas_flash_attention(q, k, v, causal=causal)
+    return _blockwise_reference(q, k, v, causal, block_q, block_k)

@@ -1,0 +1,17 @@
+"""Parallelism layer: mesh construction, shardings, collectives, multi-host.
+
+Replaces the reference's borrowed Spark control plane and HTTP/socket data
+plane (SURVEY.md §2.3): tensor traffic rides ICI via XLA collectives
+(``psum``/``pmean``/``ppermute``) inside compiled programs; DCN is used
+only by ``jax.distributed`` for multi-host coordination.
+"""
+
+from elephas_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    build_mesh,
+    data_sharding,
+    local_device_count,
+    replicated_sharding,
+)

@@ -1,0 +1,92 @@
+"""Device mesh construction and canonical shardings.
+
+The reference has no notion of device topology — Spark hands it opaque
+executors (SURVEY.md §1 "no scheduler, no comm library"). Here topology is
+explicit: a ``jax.sharding.Mesh`` whose axes name the parallelism
+strategies. Data parallelism (the reference's only strategy) uses the
+``'data'`` axis; ``'model'`` and ``'seq'`` axes are reserved so tensor /
+sequence parallelism (ring attention) compose with the same mesh rather
+than requiring a redesign — see SURVEY.md §5.7.
+
+Axis layout convention: the data axis is the *outermost* mesh dimension so
+that on multi-host pods, consecutive-device model/seq groups stay within a
+host's ICI domain and only gradient allreduce crosses hosts (the
+scaling-book recipe: collectives ride ICI, not DCN).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def build_mesh(
+    num_data: Optional[int] = None,
+    num_model: int = 1,
+    num_seq: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(data, seq, model)`` mesh over the given devices.
+
+    With only ``num_data`` set (the data-parallel case covering the whole
+    reference feature set) this is a 1-axis mesh over all devices. Axes of
+    size 1 are still present so sharding specs can always name them.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if num_data is None:
+        if n % (num_model * num_seq) != 0:
+            raise ValueError(
+                f"{n} devices not divisible by model×seq = {num_model * num_seq}"
+            )
+        num_data = n // (num_model * num_seq)
+    want = num_data * num_model * num_seq
+    if want > n:
+        raise ValueError(f"mesh wants {want} devices, only {n} available")
+    grid = np.array(devices[:want]).reshape(num_data, num_seq, num_model)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Shard the leading (batch) dimension over the data axis."""
+    spec = P(DATA_AXIS, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated sharding (parameters in pure data parallelism)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_spec() -> P:
+    """PartitionSpec for batches inside shard_map bodies."""
+    return P(DATA_AXIS)
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """Place host arrays as globally-sharded ``jax.Array``s over ``'data'``.
+
+    Each array's leading dim must divide evenly by the data-axis size
+    (callers use ``ShardedDataset.even_shards`` to guarantee this).
+    Returns a tuple matching the inputs (``None`` passes through).
+    """
+    out = []
+    for arr in arrays:
+        if arr is None:
+            out.append(None)
+            continue
+        sharding = data_sharding(mesh, np.ndim(arr))
+        out.append(jax.device_put(arr, sharding))
+    return tuple(out)

@@ -1,0 +1,34 @@
+"""Parameter exchange layer (reference L2 — SURVEY.md §1).
+
+Reference: ``elephas/parameter/{base,server,client}.py`` — a Flask HTTP or
+raw-socket parameter server on the Spark driver, pickled weight lists over
+the network, 2 network hops per worker per ``frequency`` unit.
+
+TPU-native redesign: the canonical store is an HBM-resident
+``ParameterBuffer`` (weights live on a chip, updates are jitted on-device
+adds). Transports are pluggable on top for cross-host parity:
+
+- ``local``  — in-process buffer handle (single-host pods; zero copies
+  off-device except the pull into each worker chip),
+- ``http``   — stdlib ThreadingHTTPServer speaking the reference's
+  GET /parameters, POST /update protocol,
+- ``socket`` — length-prefixed pickle frames with the reference's
+  ``'g'``/``'u'`` message kinds.
+"""
+
+from elephas_tpu.parameter.base import (  # noqa: F401
+    BaseParameterClient,
+    BaseParameterServer,
+)
+from elephas_tpu.parameter.buffer import ParameterBuffer  # noqa: F401
+from elephas_tpu.parameter.server import (  # noqa: F401
+    HttpServer,
+    LocalServer,
+    SocketServer,
+    make_server,
+)
+from elephas_tpu.parameter.client import (  # noqa: F401
+    HttpClient,
+    LocalClient,
+    SocketClient,
+)

@@ -1,0 +1,42 @@
+"""Abstract parameter server/client interfaces.
+
+Reference: ``elephas/parameter/base.py::{BaseParameterServer,
+BaseParameterClient}`` (SURVEY.md §2.1 "PS servers"/"PS clients" rows).
+"""
+
+from __future__ import annotations
+
+import abc
+
+
+class BaseParameterServer(abc.ABC):
+    """Central weight store for async/hogwild modes."""
+
+    @abc.abstractmethod
+    def start(self) -> None:
+        """Begin serving (no-op for in-process stores)."""
+
+    @abc.abstractmethod
+    def stop(self) -> None:
+        """Stop serving and release resources."""
+
+    @abc.abstractmethod
+    def get_parameters(self):
+        """Current weights as a pytree (server-side view)."""
+
+    @abc.abstractmethod
+    def client(self) -> "BaseParameterClient":
+        """A client wired to this server (in-process or via its transport)."""
+
+
+class BaseParameterClient(abc.ABC):
+    """Worker-side pull/push of weights and deltas."""
+
+    @abc.abstractmethod
+    def get_parameters(self):
+        """Pull current weights."""
+
+    @abc.abstractmethod
+    def update_parameters(self, delta) -> None:
+        """Push a weight delta (``before - after``; server applies
+        ``weights -= delta``, matching the reference's convention)."""

@@ -1,0 +1,69 @@
+"""HBM-resident parameter buffer — the async/hogwild weight store.
+
+This replaces the reference's Flask/socket parameter server *state*
+(``elephas/parameter/server.py::HttpServer``'s weight list guarded by
+``RWLock`` — SURVEY.md §2.1, §5.2): the canonical weights live as
+``jax.Array``s on a designated device (HBM on TPU), and applying a delta
+is a jitted on-device subtract — the weights never bounce through host
+memory on the single-host path.
+
+Locking discipline (SURVEY.md §2.2):
+- ``lock=True``  (asynchronous): writer-preferring RWLock around
+  pull/apply — Downpour SGD with consistent snapshots.
+- ``lock=False`` (hogwild): ``NullLock``; pulls may interleave with
+  applies. The CPython GIL still makes each pointer swap atomic, so
+  "race" means stale/interleaved pytree reads — the Hogwild! contract,
+  not corruption (the reference's memory-model difference, documented).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+from elephas_tpu.utils.functional_utils import subtract_params
+from elephas_tpu.utils.rwlock import NullLock, RWLock
+
+
+class ParameterBuffer:
+    def __init__(self, params, lock: bool = True, device: Optional[jax.Device] = None):
+        self._device = device if device is not None else jax.devices()[0]
+        self._params = jax.device_put(params, self._device)
+        self._lock = RWLock() if lock else NullLock()
+        self._apply = jax.jit(subtract_params)
+        self._version = 0
+        self._version_guard = threading.Lock()
+
+    @property
+    def device(self) -> jax.Device:
+        return self._device
+
+    @property
+    def version(self) -> int:
+        """Number of applied updates (staleness tests / diagnostics)."""
+        return self._version
+
+    def get(self):
+        """Snapshot of the current weights (on the buffer device)."""
+        with self._lock.reading():
+            return self._params
+
+    def get_numpy(self):
+        """Host copy (for HTTP/socket transports)."""
+        with self._lock.reading():
+            params = self._params
+        return jax.device_get(params)
+
+    def apply_delta(self, delta) -> None:
+        """``weights -= delta`` on-device (reference update convention)."""
+        delta = jax.device_put(delta, self._device)
+        with self._lock.writing():
+            self._params = self._apply(self._params, delta)
+        with self._version_guard:
+            self._version += 1
+
+    def set(self, params) -> None:
+        with self._lock.writing():
+            self._params = jax.device_put(params, self._device)

@@ -1,0 +1,91 @@
+"""Parameter clients (reference: ``elephas/parameter/client.py``).
+
+``HttpClient``/``SocketClient`` keep the reference's wire behavior
+(SURVEY.md §2.1 "PS clients"); ``LocalClient`` is the TPU-native
+in-process fast path — a pull is a device-to-device copy out of the HBM
+buffer, a push is a jitted on-device subtract.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+import urllib.request
+
+import jax
+
+from elephas_tpu.parameter.base import BaseParameterClient
+from elephas_tpu.parameter.buffer import ParameterBuffer
+from elephas_tpu.utils import sockets as socket_utils
+
+
+class LocalClient(BaseParameterClient):
+    def __init__(self, buffer: ParameterBuffer):
+        self._buffer = buffer
+
+    def get_parameters(self):
+        return self._buffer.get()
+
+    def update_parameters(self, delta) -> None:
+        self._buffer.apply_delta(delta)
+
+
+class HttpClient(BaseParameterClient):
+    """urllib against ``GET /parameters`` / ``POST /update``."""
+
+    def __init__(self, master_url: str, timeout: float = 60.0):
+        self.master_url = master_url
+        self.timeout = timeout
+
+    def get_parameters(self):
+        with urllib.request.urlopen(
+            f"http://{self.master_url}/parameters", timeout=self.timeout
+        ) as resp:
+            return pickle.loads(resp.read())
+
+    def update_parameters(self, delta) -> None:
+        delta = jax.device_get(delta)
+        payload = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)
+        req = urllib.request.Request(
+            f"http://{self.master_url}/update",
+            data=payload,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout):
+            pass
+
+
+class SocketClient(BaseParameterClient):
+    """Persistent framed-TCP connection (one per worker thread)."""
+
+    def __init__(self, master_url: str):
+        host, port = master_url.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._sock = None
+        self._lock = threading.Lock()  # one in-flight request per connection
+
+    def _connection(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(self._addr, timeout=60.0)
+        return self._sock
+
+    def get_parameters(self):
+        with self._lock:
+            sock = self._connection()
+            socket_utils.send(sock, ("g", None))
+            return socket_utils.receive(sock)
+
+    def update_parameters(self, delta) -> None:
+        delta = jax.device_get(delta)
+        with self._lock:
+            sock = self._connection()
+            socket_utils.send(sock, ("u", delta))
+            socket_utils.receive(sock)  # ack
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

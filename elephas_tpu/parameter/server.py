@@ -1,0 +1,208 @@
+"""Parameter servers: in-process (local), HTTP, and raw-socket transports.
+
+Reference: ``elephas/parameter/server.py::{HttpServer, SocketServer}``
+(SURVEY.md §2.1): a Flask app with ``GET /parameters`` / ``POST /update``
+or a threaded TCP server speaking ``'g'``/``'u'`` framed pickle messages,
+locking iff mode is ``asynchronous``.
+
+All three servers here share one ``ParameterBuffer`` (HBM-resident store +
+lock discipline); the HTTP/socket ones add a wire transport for cross-host
+workers. Flask is replaced by the stdlib ``ThreadingHTTPServer`` — same
+protocol, no dependency.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import jax
+
+from elephas_tpu.parameter.base import BaseParameterServer
+from elephas_tpu.parameter.buffer import ParameterBuffer
+from elephas_tpu.utils import sockets as socket_utils
+
+
+class LocalServer(BaseParameterServer):
+    """In-process server: workers share the HBM buffer directly.
+
+    The TPU-native default for single-host training — "serving" is just
+    handing out a buffer handle; pulls are device-to-device copies.
+    """
+
+    def __init__(self, params, lock: bool = True, device: Optional[jax.Device] = None):
+        self.buffer = ParameterBuffer(params, lock=lock, device=device)
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def get_parameters(self):
+        return self.buffer.get()
+
+    def client(self):
+        from elephas_tpu.parameter.client import LocalClient
+
+        return LocalClient(self.buffer)
+
+
+class HttpServer(BaseParameterServer):
+    """HTTP transport over a ParameterBuffer (reference ``HttpServer``).
+
+    Protocol parity: ``GET /parameters`` returns pickled weights,
+    ``POST /update`` applies a pickled delta. Runs in a daemon thread.
+    """
+
+    def __init__(
+        self,
+        params,
+        lock: bool = True,
+        port: int = 4000,
+        device: Optional[jax.Device] = None,
+        host: str = "0.0.0.0",
+    ):
+        self.buffer = ParameterBuffer(params, lock=lock, device=device)
+        self.host = host
+        self.port = port
+        self._httpd = None
+        self._thread = None
+
+    def start(self) -> None:
+        buffer = self.buffer
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") == "/parameters":
+                    payload = pickle.dumps(
+                        buffer.get_numpy(), protocol=pickle.HIGHEST_PROTOCOL
+                    )
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):  # noqa: N802
+                if self.path.rstrip("/") == "/update":
+                    length = int(self.headers.get("Content-Length", 0))
+                    delta = pickle.loads(self.rfile.read(length))
+                    buffer.apply_delta(delta)
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                else:
+                    self.send_error(404)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        if self.port == 0:  # ephemeral port (tests)
+            self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def get_parameters(self):
+        return self.buffer.get()
+
+    @property
+    def master_url(self) -> str:
+        return socket_utils.determine_master(self.port)
+
+    def client(self):
+        from elephas_tpu.parameter.client import HttpClient
+
+        return HttpClient(f"127.0.0.1:{self.port}")
+
+
+class _SocketHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        buffer = self.server.buffer  # type: ignore[attr-defined]
+        try:
+            while True:
+                kind, payload = socket_utils.receive(self.request)
+                if kind == "g":
+                    socket_utils.send(self.request, buffer.get_numpy())
+                elif kind == "u":
+                    buffer.apply_delta(payload)
+                    socket_utils.send(self.request, b"ok")
+                else:
+                    break
+        except (ConnectionError, OSError):
+            pass
+
+
+class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class SocketServer(BaseParameterServer):
+    """Raw-TCP transport (reference ``SocketServer``): persistent
+    connections carrying ``('g', None)`` / ``('u', delta)`` frames."""
+
+    def __init__(
+        self,
+        params,
+        lock: bool = True,
+        port: int = 4000,
+        device: Optional[jax.Device] = None,
+        host: str = "0.0.0.0",
+    ):
+        self.buffer = ParameterBuffer(params, lock=lock, device=device)
+        self.host = host
+        self.port = port
+        self._server = None
+        self._thread = None
+
+    def start(self) -> None:
+        self._server = _ThreadingTCPServer((self.host, self.port), _SocketHandler)
+        self._server.buffer = self.buffer  # type: ignore[attr-defined]
+        if self.port == 0:
+            self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def get_parameters(self):
+        return self.buffer.get()
+
+    def client(self):
+        from elephas_tpu.parameter.client import SocketClient
+
+        return SocketClient(f"127.0.0.1:{self.port}")
+
+
+def make_server(
+    mode: str,
+    params,
+    lock: bool = True,
+    port: int = 4000,
+    device: Optional[jax.Device] = None,
+) -> BaseParameterServer:
+    """Factory keyed on the reference's ``parameter_server_mode``."""
+    if mode == "local":
+        return LocalServer(params, lock=lock, device=device)
+    if mode == "http":
+        return HttpServer(params, lock=lock, port=port, device=device)
+    if mode == "socket":
+        return SocketServer(params, lock=lock, port=port, device=device)
+    raise ValueError(f"parameter_server_mode must be local|http|socket, got {mode!r}")

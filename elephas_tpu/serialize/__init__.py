@@ -1,0 +1,6 @@
+"""Model serialization (reference: ``elephas/utils/serialization.py``)."""
+
+from elephas_tpu.serialize.serialization import (  # noqa: F401
+    dict_to_model,
+    model_to_dict,
+)

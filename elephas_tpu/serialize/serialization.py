@@ -1,0 +1,112 @@
+"""Model <-> dict wire format.
+
+Reference: ``elephas/utils/serialization.py::{model_to_dict, dict_to_model}``
+(SURVEY.md §2.1) — there, Keras arch JSON + a weight list; it is the
+broadcast payload and the parameter-server wire format.
+
+Here the payload is: architecture (registry ``{"name", "kwargs"}`` when the
+module came from ``elephas_tpu.models``, else a pickled flax module),
+weights as a flax state dict (nested plain dicts of numpy arrays — stable
+across flax versions), optimizer/loss/metric configs. The dict is
+pickle/JSON-friendly (numpy leaves) and is exactly what the checkpointing
+and the HTTP/socket parameter transports carry.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+import jax
+import numpy as np
+from flax import serialization as flax_serialization
+
+
+def _to_numpy_tree(tree):
+    return jax.tree_util.tree_map(np.asarray, flax_serialization.to_state_dict(tree))
+
+
+def model_to_dict(compiled) -> dict:
+    """Serialize a ``CompiledModel`` to a plain dict."""
+    if compiled.model_config is not None:
+        arch = {"kind": "registry", "config": compiled.model_config}
+    else:
+        arch = {"kind": "pickle", "payload": pickle.dumps(compiled.module)}
+    if compiled.optimizer_config is not None:
+        opt = {"kind": "config", "config": compiled.optimizer_config}
+    else:
+        opt = {"kind": "pickle", "payload": pickle.dumps(compiled.optimizer)}
+    loss = (
+        compiled.loss_spec
+        if isinstance(compiled.loss_spec, str)
+        else {"kind": "pickle", "payload": pickle.dumps(compiled.loss_spec)}
+    )
+    metrics = [
+        m if isinstance(m, str) else {"kind": "pickle", "payload": pickle.dumps(m)}
+        for m in compiled.metric_specs
+    ]
+    return {
+        "arch": arch,
+        "weights": _to_numpy_tree(compiled.params),
+        "batch_stats": _to_numpy_tree(compiled.batch_stats),
+        "optimizer": opt,
+        "loss": loss,
+        "metrics": metrics,
+        "input_shape": compiled.input_shape,
+        "input_dtype": str(np.dtype(compiled.input_dtype)) if compiled.input_shape else None,
+    }
+
+
+def dict_to_model(payload: dict, custom_objects: Optional[dict] = None):
+    """Rebuild a ``CompiledModel`` from ``model_to_dict`` output.
+
+    ``custom_objects`` mirrors the reference kwarg: a mapping of names made
+    available when unpickling custom losses/modules is not needed here
+    (pickle restores by import path), but names listed in it override
+    registry lookups, letting tests inject stand-ins.
+    """
+    from elephas_tpu.api.compile import CompiledModel
+    from elephas_tpu.models import get_model
+
+    custom_objects = custom_objects or {}
+
+    arch = payload["arch"]
+    if arch["kind"] == "registry":
+        name = arch["config"]["name"]
+        if name in custom_objects:
+            module = custom_objects[name](**arch["config"]["kwargs"])
+            model_config = None
+        else:
+            module = get_model(name, **arch["config"]["kwargs"])
+            model_config = arch["config"]
+    else:
+        module = pickle.loads(arch["payload"])
+        model_config = None
+
+    opt = payload["optimizer"]
+    optimizer = opt["config"] if opt["kind"] == "config" else pickle.loads(opt["payload"])
+
+    loss = payload["loss"]
+    if isinstance(loss, dict):
+        loss = pickle.loads(loss["payload"])
+    metrics = [
+        m if isinstance(m, str) else pickle.loads(m["payload"])
+        for m in payload.get("metrics", ())
+    ]
+
+    # Build with placeholder weights via the module's own init? No — restore
+    # the exact state dict instead: construct with params directly.
+    weights = payload["weights"]
+    batch_stats = payload.get("batch_stats") or {}
+    compiled = CompiledModel(
+        module,
+        params=weights,
+        optimizer=optimizer,
+        loss=loss,
+        metrics=metrics,
+        batch_stats=batch_stats,
+        model_config=model_config,
+        input_shape=payload.get("input_shape"),
+        input_dtype=np.dtype(payload["input_dtype"]) if payload.get("input_dtype") else np.float32,
+    )
+    return compiled

@@ -1,0 +1,1 @@
+"""Utility layer (reference: ``elephas/utils/`` — SURVEY.md §2.1 L1)."""

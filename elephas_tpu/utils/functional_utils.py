@@ -1,0 +1,75 @@
+"""Weight/update algebra over pytrees.
+
+Reference: ``elephas/utils/functional_utils.py::{add_params,
+subtract_params, divide_by, get_neutral_vector}`` (SURVEY.md §2.1) — the
+entire gradient-aggregation math of the reference, there implemented as
+elementwise loops over Python lists of numpy arrays.
+
+TPU-native redesign: parameters are arbitrary JAX pytrees (flax
+``FrozenDict``s, plain dicts, lists), the ops are ``jax.tree_util`` maps
+that jit/vmap cleanly and run on-device, so delta aggregation can live
+inside a compiled step (e.g. under ``lax.psum``) instead of on a Python
+driver. The reference's list-of-ndarray format is a special case of a
+pytree, so the API is a strict superset.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def add_params(tree_a, tree_b):
+    """Elementwise ``a + b`` over two matching pytrees of arrays."""
+    return jax.tree_util.tree_map(jnp.add, tree_a, tree_b)
+
+
+def subtract_params(tree_a, tree_b):
+    """Elementwise ``a - b`` over two matching pytrees of arrays.
+
+    ``subtract_params(before, after)`` is the reference's definition of a
+    worker's weight *delta* (applied by the driver as ``base - mean_delta``).
+    """
+    return jax.tree_util.tree_map(jnp.subtract, tree_a, tree_b)
+
+
+def divide_by(tree, num_workers):
+    """Divide every leaf by a scalar (delta averaging)."""
+    return jax.tree_util.tree_map(lambda x: x / num_workers, tree)
+
+
+def scale_params(tree, factor):
+    """Multiply every leaf by a scalar."""
+    return jax.tree_util.tree_map(lambda x: x * factor, tree)
+
+
+def get_neutral_vector(tree):
+    """A zeros-like pytree — the neutral element of ``add_params``."""
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def average_params(trees):
+    """Mean of a non-empty sequence of matching pytrees.
+
+    Driver-side fold used by the synchronous parity path (the reference
+    folds ``add_params`` over collected partition deltas then divides).
+    On-device averaging uses ``lax.pmean`` instead — see
+    ``elephas_tpu.engine.sync``.
+    """
+    if not trees:
+        raise ValueError("average_params needs at least one pytree")
+    total = trees[0]
+    for tree in trees[1:]:
+        total = add_params(total, tree)
+    return divide_by(total, float(len(trees)))
+
+
+def tree_size(tree):
+    """Total number of scalar elements across all leaves."""
+    return sum(leaf.size for leaf in jax.tree_util.tree_leaves(tree))
+
+
+def global_norm(tree):
+    """L2 norm over all leaves (diagnostics / staleness tests)."""
+    leaves = [jnp.sum(jnp.square(leaf)) for leaf in jax.tree_util.tree_leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))

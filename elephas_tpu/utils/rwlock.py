@@ -1,0 +1,97 @@
+"""Readers-writer lock.
+
+Reference: ``elephas/utils/rwlock.py::RWLock`` (SURVEY.md §2.1, §5.2) —
+guards the parameter-server weight state in ``asynchronous`` mode and is
+deliberately bypassed in ``hogwild`` mode (lock-free, Hogwild!-style).
+
+This implementation is writer-preferring: once a writer is waiting, new
+readers queue behind it, so pull-heavy Downpour loops cannot starve the
+merge thread. The reference exposes ``acquire_read`` / ``acquire_write`` /
+``release``; we keep those names and add context-manager helpers, which is
+what the engine uses internally.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class RWLock:
+    """Writer-preferring readers-writer lock with the reference's API."""
+
+    def __init__(self):
+        self._cond = threading.Condition(threading.Lock())
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def acquire_write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+
+    def release(self):
+        """Release whichever side the calling thread holds."""
+        with self._cond:
+            if self._writer:
+                self._writer = False
+            elif self._readers:
+                self._readers -= 1
+            else:
+                raise RuntimeError("release() without a held lock")
+            self._cond.notify_all()
+
+    @contextmanager
+    def reading(self):
+        self.acquire_read()
+        try:
+            yield self
+        finally:
+            self.release()
+
+    @contextmanager
+    def writing(self):
+        self.acquire_write()
+        try:
+            yield self
+        finally:
+            self.release()
+
+
+class NullLock:
+    """Lock-shaped no-op used by ``hogwild`` mode (SURVEY.md §2.2).
+
+    Updates proceed unfenced / last-writer-wins. On the host side the
+    CPython GIL still serializes the actual pointer swap, so "race" here
+    means interleaved read-modify-write at the pytree level — exactly the
+    Hogwild! algorithmic contract, not memory corruption.
+    """
+
+    def acquire_read(self):
+        pass
+
+    def acquire_write(self):
+        pass
+
+    def release(self):
+        pass
+
+    @contextmanager
+    def reading(self):
+        yield self
+
+    @contextmanager
+    def writing(self):
+        yield self

@@ -1,0 +1,58 @@
+"""Socket helpers: master discovery + length-prefixed wire framing.
+
+Reference: ``elephas/utils/sockets.py::{determine_master, send, receive}``
+(SURVEY.md §2.1) — the reference frames pickled Python objects with a
+length prefix over raw TCP and discovers the driver endpoint via
+``socket.gethostbyname(gethostname())``.
+
+Here the same framing carries *control-plane* traffic only (async-mode
+deltas between hosts, trial dispatch). Tensor data between chips rides ICI
+via XLA collectives (SURVEY.md §2.3) and never touches these sockets on
+the single-host path. Frames are ``!Q``-length-prefixed pickles; pickle is
+acceptable because every endpoint is part of the same trusted job (same
+trust model as the reference and as Spark's closure shipping).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+
+_LEN = struct.Struct("!Q")
+
+
+def determine_master(port: int = 4000) -> str:
+    """Return ``"<host_ip>:<port>"`` for the driver/host-0 endpoint.
+
+    Mirrors the reference's ``determine_master``; used to embed the
+    parameter-server address into worker closures.
+    """
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+    except socket.gaierror:
+        ip = "127.0.0.1"
+    return f"{ip}:{port}"
+
+
+def send(sock: socket.socket, obj) -> None:
+    """Pickle ``obj`` and send it with an 8-byte length prefix."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def receive(sock: socket.socket):
+    """Receive one length-prefixed pickled object (inverse of ``send``)."""
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, length))

@@ -1,0 +1,73 @@
+"""DataFrame + ml/mllib adapter tests (reference adapter tests §4)."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.data.dataframe import (
+    DataFrame,
+    df_to_simple_rdd,
+    from_data_frame,
+    to_data_frame,
+)
+from elephas_tpu.data import mllib
+
+
+def test_dataframe_basics():
+    df = DataFrame({"a": np.arange(5), "b": np.ones((5, 3))})
+    assert df.count() == 5
+    assert set(df.columns) == {"a", "b"}
+    sel = df.select("a")
+    assert sel.columns == ["a"]
+    df2 = df.with_column("c", np.zeros(5))
+    assert "c" in df2.columns and "c" not in df.columns
+    assert df2.drop("c").columns == df.columns
+    assert len(df.limit(2)) == 2
+    with pytest.raises(ValueError):
+        DataFrame({"a": np.arange(5), "b": np.arange(4)})
+    with pytest.raises(KeyError):
+        df.select("missing")
+
+
+def test_dataframe_pandas_roundtrip():
+    df = DataFrame({"features": np.random.default_rng(0).normal(size=(6, 4)), "label": np.arange(6.0)})
+    pdf = df.to_pandas()
+    back = DataFrame.from_pandas(pdf)
+    np.testing.assert_allclose(back["features"], df["features"])
+    np.testing.assert_allclose(back["label"], df["label"])
+
+
+def test_to_from_data_frame_categorical():
+    x = np.random.default_rng(0).normal(size=(12, 5)).astype(np.float32)
+    y_int = np.random.default_rng(1).integers(0, 3, size=12)
+    y = np.eye(3, dtype=np.float32)[y_int]
+    df = to_data_frame(None, x, y, categorical=True)
+    np.testing.assert_array_equal(df["label"], y_int.astype(np.float32))
+    fx, fy = from_data_frame(df, categorical=True, nb_classes=3)
+    np.testing.assert_allclose(fx, x)
+    np.testing.assert_array_equal(fy, y)
+
+
+def test_df_to_simple_rdd():
+    x = np.random.default_rng(0).normal(size=(16, 5)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 2, size=16).astype(np.float32)
+    df = to_data_frame(None, x, y, categorical=False)
+    rdd = df_to_simple_rdd(df, categorical=True, nb_classes=2, num_partitions=4)
+    assert rdd.getNumPartitions() == 4
+    assert rdd.labels.shape == (16, 2)
+
+
+def test_mllib_vector_roundtrip():
+    v = np.array([1.0, 2.0, 3.0])
+    vec = mllib.to_vector(v)
+    np.testing.assert_array_equal(mllib.from_vector(vec), v)
+    with pytest.raises(ValueError):
+        mllib.to_vector(np.ones((2, 2)))
+
+
+def test_mllib_matrix_roundtrip():
+    m = np.arange(6.0).reshape(2, 3)
+    mat = mllib.to_matrix(m)
+    assert mat.numRows == 2 and mat.numCols == 3
+    np.testing.assert_array_equal(mllib.from_matrix(mat), m)
+    with pytest.raises(ValueError):
+        mllib.to_matrix(np.ones(3))

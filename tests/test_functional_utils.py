@@ -1,0 +1,74 @@
+"""Exact unit tests for the weight algebra (reference test strategy §4:
+exact assertions for pure functions)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.utils import functional_utils as fu
+
+
+@pytest.fixture
+def trees():
+    a = {"dense": {"w": jnp.ones((2, 3)), "b": jnp.arange(3.0)}, "scale": jnp.float32(2.0)}
+    b = {"dense": {"w": jnp.full((2, 3), 2.0), "b": jnp.ones(3)}, "scale": jnp.float32(0.5)}
+    return a, b
+
+
+def test_add_params(trees):
+    a, b = trees
+    out = fu.add_params(a, b)
+    np.testing.assert_allclose(out["dense"]["w"], 3.0 * np.ones((2, 3)))
+    np.testing.assert_allclose(out["dense"]["b"], np.arange(3.0) + 1)
+    assert float(out["scale"]) == 2.5
+
+
+def test_subtract_params(trees):
+    a, b = trees
+    out = fu.subtract_params(a, b)
+    np.testing.assert_allclose(out["dense"]["w"], -1.0 * np.ones((2, 3)))
+    assert float(out["scale"]) == 1.5
+
+
+def test_divide_scale_neutral(trees):
+    a, _ = trees
+    half = fu.divide_by(a, 2.0)
+    np.testing.assert_allclose(half["dense"]["w"], 0.5 * np.ones((2, 3)))
+    doubled = fu.scale_params(a, 2.0)
+    np.testing.assert_allclose(doubled["dense"]["b"], 2 * np.arange(3.0))
+    zeros = fu.get_neutral_vector(a)
+    assert float(jnp.sum(zeros["dense"]["w"])) == 0.0
+    # neutral element law: a + 0 == a
+    same = fu.add_params(a, zeros)
+    np.testing.assert_allclose(same["dense"]["w"], a["dense"]["w"])
+
+
+def test_average_params(trees):
+    a, b = trees
+    avg = fu.average_params([a, b])
+    np.testing.assert_allclose(avg["dense"]["w"], 1.5 * np.ones((2, 3)))
+    with pytest.raises(ValueError):
+        fu.average_params([])
+
+
+def test_average_matches_reference_fold(trees):
+    """average == fold(add) / n — the reference driver's aggregation."""
+    a, b = trees
+    folded = fu.divide_by(fu.add_params(a, b), 2.0)
+    avg = fu.average_params([a, b])
+    np.testing.assert_allclose(avg["dense"]["b"], folded["dense"]["b"])
+
+
+def test_works_on_list_of_ndarrays():
+    """The reference's list-of-ndarray weights are a valid pytree."""
+    a = [np.ones(3), np.zeros((2, 2))]
+    b = [np.ones(3), np.ones((2, 2))]
+    out = fu.add_params(a, b)
+    assert isinstance(out, list)
+    np.testing.assert_allclose(out[0], 2 * np.ones(3))
+
+
+def test_tree_size_and_norm():
+    tree = {"w": jnp.ones((3, 4)), "b": jnp.ones(5)}
+    assert fu.tree_size(tree) == 17
+    np.testing.assert_allclose(float(fu.global_norm(tree)), np.sqrt(17.0), rtol=1e-6)

@@ -1,0 +1,68 @@
+"""Mesh/sharding unit tests (exact, per SURVEY.md §4 rebuild translation)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from elephas_tpu.parallel.mesh import (
+    DATA_AXIS,
+    build_mesh,
+    data_sharding,
+    replicated_sharding,
+    shard_batch,
+)
+from elephas_tpu.engine.sync import stack_epoch
+
+
+def test_build_mesh_default_all_devices(devices):
+    mesh = build_mesh()
+    assert mesh.shape[DATA_AXIS] == 8
+    assert mesh.shape["model"] == 1 and mesh.shape["seq"] == 1
+
+
+def test_build_mesh_subset_and_axes(devices):
+    mesh = build_mesh(num_data=4)
+    assert mesh.shape[DATA_AXIS] == 4
+    mesh2 = build_mesh(num_data=2, num_model=2, num_seq=2)
+    assert mesh2.shape == {"data": 2, "seq": 2, "model": 2}
+    with pytest.raises(ValueError):
+        build_mesh(num_data=16)
+
+
+def test_shard_batch_places_shards(devices):
+    mesh = build_mesh(num_data=8)
+    x = np.arange(64, dtype=np.float32).reshape(16, 4)
+    (gx,) = shard_batch(mesh, x)
+    assert gx.shape == (16, 4)
+    assert len(gx.sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(gx), x)
+
+
+def test_replicated_and_data_sharding_specs(devices):
+    mesh = build_mesh(num_data=4)
+    assert replicated_sharding(mesh).spec == P()
+    assert data_sharding(mesh, ndim=3).spec == P(DATA_AXIS, None, None)
+
+
+def test_stack_epoch_partition_faithful():
+    """Column block d of each global batch must hold partition d's rows."""
+    n_shards, bs = 4, 2
+    x = np.arange(32, dtype=np.float32).reshape(32, 1)
+    y = np.arange(32, dtype=np.float32)
+    xs, ys, nb = stack_epoch(x, y, n_shards, bs)
+    assert xs.shape == (nb, n_shards * bs, 1)
+    # partition 0 owns rows 0..7 (contiguous split of 32 rows over 4 shards)
+    for b in range(nb):
+        np.testing.assert_array_equal(
+            xs[b, :bs, 0], x[b * bs : (b + 1) * bs, 0]
+        )
+        # shard 1's column block draws from rows 8..15
+        np.testing.assert_array_equal(
+            xs[b, bs : 2 * bs, 0], x[8 + b * bs : 8 + (b + 1) * bs, 0]
+        )
+
+
+def test_stack_epoch_too_small_raises():
+    with pytest.raises(ValueError):
+        stack_epoch(np.zeros((4, 1)), np.zeros(4), 8, 32)

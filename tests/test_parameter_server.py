@@ -1,0 +1,85 @@
+"""Parameter buffer + server/client transport tests (reference §4:
+in-process HttpServer/SocketServer exercised via clients)."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from elephas_tpu.parameter.buffer import ParameterBuffer
+from elephas_tpu.parameter.server import HttpServer, LocalServer, SocketServer, make_server
+
+
+def _params():
+    return {
+        "dense": {"w": np.ones((4, 4), dtype=np.float32), "b": np.zeros(4, dtype=np.float32)}
+    }
+
+
+def test_buffer_apply_delta_convention():
+    """weights -= delta (delta = before - after, reference convention)."""
+    buf = ParameterBuffer(_params(), lock=True)
+    delta = {"dense": {"w": np.full((4, 4), 0.25, np.float32), "b": np.zeros(4, np.float32)}}
+    buf.apply_delta(delta)
+    out = buf.get_numpy()
+    np.testing.assert_allclose(out["dense"]["w"], 0.75)
+    assert buf.version == 1
+
+
+def test_buffer_concurrent_updates_all_applied():
+    """With the lock, no update is lost (unlike hogwild)."""
+    buf = ParameterBuffer(_params(), lock=True)
+    delta = {"dense": {"w": np.full((4, 4), 0.01, np.float32), "b": np.zeros(4, np.float32)}}
+
+    def pusher():
+        for _ in range(20):
+            buf.apply_delta(delta)
+
+    threads = [threading.Thread(target=pusher) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out = buf.get_numpy()
+    np.testing.assert_allclose(out["dense"]["w"], 1.0 - 80 * 0.01, rtol=1e-5)
+    assert buf.version == 80
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_transport_get_update_roundtrip(server_cls):
+    server = server_cls(_params(), lock=True, port=0)
+    server.start()
+    try:
+        client = server.client()
+        pulled = client.get_parameters()
+        np.testing.assert_allclose(pulled["dense"]["w"], 1.0)
+        delta = {
+            "dense": {"w": np.full((4, 4), 0.5, np.float32), "b": np.ones(4, np.float32)}
+        }
+        client.update_parameters(delta)
+        pulled2 = client.get_parameters()
+        np.testing.assert_allclose(pulled2["dense"]["w"], 0.5)
+        np.testing.assert_allclose(pulled2["dense"]["b"], -1.0)
+        if hasattr(client, "close"):
+            client.close()
+    finally:
+        server.stop()
+
+
+def test_local_server_shares_buffer():
+    server = LocalServer(_params(), lock=False)
+    client_a, client_b = server.client(), server.client()
+    delta = {"dense": {"w": np.full((4, 4), 1.0, np.float32), "b": np.zeros(4, np.float32)}}
+    client_a.update_parameters(delta)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(client_b.get_parameters())["dense"]["w"]), 0.0
+    )
+
+
+def test_make_server_factory():
+    assert isinstance(make_server("local", _params()), LocalServer)
+    assert isinstance(make_server("http", _params(), port=0), HttpServer)
+    assert isinstance(make_server("socket", _params(), port=0), SocketServer)
+    with pytest.raises(ValueError):
+        make_server("flask", _params())

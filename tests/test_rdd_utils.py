@@ -1,0 +1,95 @@
+"""ShardedDataset / RDD-utils parity tests (reference rdd_utils tests §4)."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.data.rdd import (
+    LabeledPoint,
+    ShardedDataset,
+    encode_label,
+    from_labeled_point,
+    lp_to_simple_rdd,
+    to_labeled_point,
+    to_simple_rdd,
+)
+
+
+def test_to_simple_rdd_partitions():
+    x = np.arange(100).reshape(100, 1).astype(np.float32)
+    y = np.arange(100).astype(np.float32)
+    rdd = to_simple_rdd(None, x, y, num_partitions=4)
+    assert rdd.getNumPartitions() == 4
+    assert rdd.count() == 100
+    assert sum(rdd.partition_sizes()) == 100
+    # Partition-faithful: concatenating partitions reproduces the data.
+    parts = [rdd.partition(i) for i in range(4)]
+    np.testing.assert_array_equal(np.concatenate([p[0] for p in parts]), x)
+
+
+def test_uneven_partitions():
+    x = np.arange(10).reshape(10, 1).astype(np.float32)
+    y = np.zeros(10, dtype=np.float32)
+    rdd = ShardedDataset(x, y, num_partitions=3)
+    sizes = rdd.partition_sizes()
+    assert sum(sizes) == 10
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_repartition_and_shuffle():
+    x = np.arange(64).reshape(64, 1).astype(np.float32)
+    y = np.arange(64).astype(np.float32)
+    rdd = ShardedDataset(x, y, 2).repartition(8)
+    assert rdd.getNumPartitions() == 8
+    shuffled = rdd.shuffle(seed=1)
+    assert not np.array_equal(shuffled.features, rdd.features)
+    # Pairing preserved under shuffle.
+    np.testing.assert_array_equal(shuffled.features[:, 0], shuffled.labels)
+
+
+def test_even_shards_truncates():
+    x = np.arange(10).reshape(10, 1).astype(np.float32)
+    rdd = ShardedDataset(x, np.zeros(10), 1)
+    fx, fy = rdd.even_shards(4)
+    assert len(fx) == 8 and len(fy) == 8
+
+
+def test_validation_errors():
+    x = np.zeros((4, 2))
+    with pytest.raises(ValueError):
+        ShardedDataset(x, np.zeros(3), 1)  # length mismatch
+    with pytest.raises(ValueError):
+        ShardedDataset(x, np.zeros(4), 8)  # more partitions than rows
+
+
+def test_encode_label():
+    np.testing.assert_array_equal(encode_label(2, 4), [0, 0, 1, 0])
+
+
+def test_labeled_point_roundtrip_categorical():
+    x = np.random.default_rng(0).normal(size=(20, 3)).astype(np.float32)
+    y_int = np.random.default_rng(1).integers(0, 4, size=20)
+    y = np.eye(4, dtype=np.float32)[y_int]
+    points = to_labeled_point(None, x, y, categorical=True)
+    assert isinstance(points[0], LabeledPoint)
+    assert points[0].label == float(y_int[0])
+    fx, fy = from_labeled_point(points, categorical=True, nb_classes=4)
+    np.testing.assert_allclose(fx, x)
+    np.testing.assert_array_equal(fy, y)
+
+
+def test_labeled_point_roundtrip_regression():
+    x = np.random.default_rng(0).normal(size=(10, 3)).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    points = to_labeled_point(None, x, y, categorical=False)
+    fx, fy = from_labeled_point(points)
+    np.testing.assert_allclose(fy, y)
+
+
+def test_lp_to_simple_rdd():
+    x = np.random.default_rng(0).normal(size=(24, 3)).astype(np.float32)
+    y_int = np.random.default_rng(1).integers(0, 3, size=24)
+    y = np.eye(3, dtype=np.float32)[y_int]
+    points = to_labeled_point(None, x, y, categorical=True)
+    rdd = lp_to_simple_rdd(points, categorical=True, nb_classes=3, num_partitions=4)
+    assert rdd.getNumPartitions() == 4
+    np.testing.assert_array_equal(rdd.labels, y)

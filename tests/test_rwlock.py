@@ -1,0 +1,93 @@
+"""RWLock discipline tests (reference §5.2: async locks, hogwild doesn't)."""
+
+import threading
+import time
+
+import pytest
+
+from elephas_tpu.utils.rwlock import NullLock, RWLock
+
+
+def test_multiple_readers():
+    lock = RWLock()
+    active = []
+
+    def reader():
+        with lock.reading():
+            active.append(1)
+            time.sleep(0.05)
+            active.pop()
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Readers overlap: 4 × 50ms must finish well under 200ms serial time.
+    assert time.monotonic() - start < 0.15
+
+
+def test_writer_excludes_readers():
+    lock = RWLock()
+    log = []
+
+    def writer():
+        with lock.writing():
+            log.append("w_start")
+            time.sleep(0.05)
+            log.append("w_end")
+
+    def reader():
+        time.sleep(0.01)  # let the writer in first
+        with lock.reading():
+            log.append("r")
+
+    tw = threading.Thread(target=writer)
+    tr = threading.Thread(target=reader)
+    tw.start()
+    tr.start()
+    tw.join()
+    tr.join()
+    assert log == ["w_start", "w_end", "r"]
+
+
+def test_writer_preference_no_starvation():
+    """Once a writer waits, fresh readers must queue behind it."""
+    lock = RWLock()
+    order = []
+    lock.acquire_read()
+
+    def writer():
+        lock.acquire_write()
+        order.append("w")
+        lock.release()
+
+    def late_reader():
+        time.sleep(0.02)  # after the writer queued
+        lock.acquire_read()
+        order.append("r")
+        lock.release()
+
+    tw = threading.Thread(target=writer)
+    tr = threading.Thread(target=late_reader)
+    tw.start()
+    time.sleep(0.01)
+    tr.start()
+    time.sleep(0.02)
+    lock.release()  # release initial read — writer should go first
+    tw.join()
+    tr.join()
+    assert order == ["w", "r"]
+
+
+def test_release_without_hold_raises():
+    with pytest.raises(RuntimeError):
+        RWLock().release()
+
+
+def test_null_lock_is_noop():
+    lock = NullLock()
+    with lock.reading():
+        with lock.writing():
+            pass  # no deadlock, no error

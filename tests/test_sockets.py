@@ -1,0 +1,51 @@
+"""Wire framing + master discovery (reference utils/sockets.py tests)."""
+
+import socket
+import threading
+
+import numpy as np
+
+from elephas_tpu.utils import sockets as su
+
+
+def test_determine_master_format():
+    master = su.determine_master(4000)
+    host, port = master.rsplit(":", 1)
+    assert port == "4000"
+    assert host  # resolvable-ish string
+
+
+def test_send_receive_roundtrip():
+    a, b = socket.socketpair()
+    payload = {"w": np.arange(10.0), "tag": "delta", "nested": [np.ones((3, 2))]}
+    out = {}
+
+    def rx():
+        out["obj"] = su.receive(b)
+
+    t = threading.Thread(target=rx)
+    t.start()
+    su.send(a, payload)
+    t.join()
+    np.testing.assert_allclose(out["obj"]["w"], payload["w"])
+    np.testing.assert_allclose(out["obj"]["nested"][0], np.ones((3, 2)))
+    a.close()
+    b.close()
+
+
+def test_send_receive_large_frame():
+    """Frames larger than one recv() chunk reassemble correctly."""
+    a, b = socket.socketpair()
+    big = np.random.default_rng(0).normal(size=(512, 1024)).astype(np.float32)
+    received = {}
+
+    def rx():
+        received["arr"] = su.receive(b)
+
+    t = threading.Thread(target=rx)
+    t.start()
+    su.send(a, big)
+    t.join()
+    np.testing.assert_array_equal(received["arr"], big)
+    a.close()
+    b.close()

@@ -1,0 +1,140 @@
+"""End-to-end SparkModel training tests — the bulk, mirroring the
+reference's mode × frequency × parameter_server_mode matrix with loose
+statistical thresholds (SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from elephas_tpu import SparkModel, load_spark_model, to_simple_rdd
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.api.spark_model import SparkMLlibModel
+from elephas_tpu.data.rdd import to_labeled_point
+from elephas_tpu.models import get_model
+
+from conftest import make_blobs
+
+NUM_CLASSES, DIM = 4, 16
+
+
+def fresh_model(seed=0):
+    return CompiledModel(
+        get_model("mlp", features=(32,), num_classes=NUM_CLASSES),
+        optimizer={"name": "adam", "learning_rate": 0.01},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(DIM,),
+        seed=seed,
+    )
+
+
+@pytest.fixture(scope="module")
+def data():
+    return make_blobs(n=512, num_classes=NUM_CLASSES, dim=DIM, seed=3)
+
+
+@pytest.mark.parametrize("frequency", ["batch", "epoch", "fit"])
+def test_synchronous_modes_converge(data, frequency):
+    x, y = data
+    model = SparkModel(fresh_model(), mode="synchronous", frequency=frequency, num_workers=4)
+    rdd = to_simple_rdd(None, x, y, num_partitions=4)
+    history = model.fit(rdd, epochs=4, batch_size=16, validation_split=0.1)
+    assert history["acc"][-1] > 0.8  # loose statistical threshold
+    assert "val_acc" in history
+    ev = model.evaluate(x, y)
+    assert ev["acc"] > 0.8
+
+
+@pytest.mark.parametrize(
+    "mode,ps_mode",
+    [
+        ("asynchronous", "local"),
+        ("asynchronous", "http"),
+        ("asynchronous", "socket"),
+        ("hogwild", "local"),
+    ],
+)
+def test_async_modes_converge(data, mode, ps_mode):
+    x, y = data
+    model = SparkModel(
+        fresh_model(),
+        mode=mode,
+        frequency="epoch",
+        parameter_server_mode=ps_mode,
+        num_workers=4,
+        port=0,
+    )
+    history = model.fit(to_simple_rdd(None, x, y, 4), epochs=4, batch_size=16)
+    assert model.evaluate(x, y)["acc"] > 0.8
+    assert len(history["loss"]) == 4
+
+
+def test_async_batch_frequency(data):
+    x, y = data
+    model = SparkModel(fresh_model(), mode="asynchronous", frequency="batch", num_workers=2)
+    model.fit(to_simple_rdd(None, x, y, 2), epochs=2, batch_size=32)
+    assert model.evaluate(x, y)["acc"] > 0.8
+
+
+def test_sync_deterministic_under_fixed_seed(data):
+    """SURVEY.md §5.2: sync mode bitwise reproducible under fixed PRNG."""
+    x, y = data
+    runs = []
+    for _ in range(2):
+        model = SparkModel(fresh_model(seed=7), mode="synchronous", frequency="batch", num_workers=4)
+        model.fit(to_simple_rdd(None, x, y, 4), epochs=2, batch_size=16)
+        runs.append(model.predict(x[:16]))
+    np.testing.assert_array_equal(runs[0], runs[1])
+
+
+def test_predict_handles_remainder(data):
+    x, y = data
+    model = SparkModel(fresh_model(), mode="synchronous", frequency="batch", num_workers=4)
+    model.fit(to_simple_rdd(None, x, y, 4), epochs=1, batch_size=16)
+    preds = model.predict(x[:13])  # 13 % 4 != 0 → remainder path
+    assert preds.shape == (13, NUM_CLASSES)
+
+
+def test_fit_accepts_plain_arrays(data):
+    x, y = data
+    model = SparkModel(fresh_model(), mode="synchronous", frequency="batch", num_workers=2)
+    history = model.fit((x, y), epochs=1, batch_size=32)
+    assert "loss" in history
+
+
+def test_save_load_roundtrip(tmp_path, data):
+    x, y = data
+    model = SparkModel(fresh_model(), mode="synchronous", frequency="batch", num_workers=2)
+    model.fit(to_simple_rdd(None, x, y, 2), epochs=2, batch_size=32)
+    before = model.predict(x[:8])
+    path = os.path.join(tmp_path, "model.pkl")
+    model.save(path)
+    loaded = load_spark_model(path)
+    assert loaded.mode == "synchronous"
+    after = loaded.predict(x[:8])
+    np.testing.assert_allclose(before, after, rtol=1e-5)
+
+
+def test_mllib_model(data):
+    x, y = data
+    points = to_labeled_point(None, x, y, categorical=True)
+    model = SparkMLlibModel(fresh_model(), mode="synchronous", frequency="batch", num_workers=2)
+    model.fit(points, epochs=2, batch_size=32, categorical=True, nb_classes=NUM_CLASSES)
+    assert model.evaluate(x, y)["acc"] > 0.8
+
+
+def test_invalid_args_raise():
+    with pytest.raises(ValueError):
+        SparkModel(fresh_model(), mode="bogus")
+    with pytest.raises(ValueError):
+        SparkModel(fresh_model(), frequency="bogus")
+    with pytest.raises(TypeError):
+        SparkModel(object())
+
+
+def test_num_workers_capped_to_devices(data):
+    x, y = data
+    model = SparkModel(fresh_model(), mode="synchronous", frequency="batch", num_workers=64)
+    assert model.num_workers == 8  # virtual device count
+    model.fit(to_simple_rdd(None, x, y, 4), epochs=1, batch_size=8)
