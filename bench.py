@@ -1,0 +1,139 @@
+"""Primary benchmark: CIFAR-10 ResNet-18 samples/sec/chip (BASELINE.md).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` is measured against a per-worker CPU train-step baseline
+(the stand-in for the reference's TF-CPU Spark workers — BASELINE.json's
+"TF-CPU Spark baseline"; no published numbers exist, SURVEY.md §6).
+The CPU rate is measured once in a subprocess and cached in
+``.bench_cpu_baseline.json`` so repeat runs are fast.
+
+All diagnostics go to stderr; stdout carries only the JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+CACHE = os.path.join(REPO, ".bench_cpu_baseline.json")
+
+BATCH_TPU = 512
+BATCH_CPU = 64
+WARMUP = 5
+MEASURE = 50
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def measure_train_rate(batch_size: int, steps: int, warmup: int, dtype: str) -> float:
+    """samples/sec of the jitted ResNet-18 train step on the default backend."""
+    import jax
+    import numpy as np
+
+    from elephas_tpu.api.compile import CompiledModel
+    from elephas_tpu.engine.step import init_train_state, make_train_step
+    from elephas_tpu.models import get_model
+
+    module = get_model("resnet18", num_classes=10, width=64, dtype=dtype)
+    compiled = CompiledModel(
+        module,
+        optimizer={"name": "momentum", "learning_rate": 0.1},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(32, 32, 3),
+    )
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch_size, 32, 32, 3)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch_size)]
+    # Pin everything to ONE device: the metric is samples/sec/chip, so the
+    # measurement itself must be single-chip even on a multi-chip host.
+    device = jax.devices()[0]
+    x, y = jax.device_put(x, device), jax.device_put(y, device)
+
+    step = jax.jit(make_train_step(compiled), donate_argnums=(0,))
+    state = jax.device_put(init_train_state(compiled), device)
+    for _ in range(warmup):
+        state, metrics = step(state, x, y)
+    # Anchor on a value fetch, not block_until_ready: remote-tunneled TPU
+    # backends (axon) have been observed to return from block_until_ready
+    # without the execution chain having finished, inflating rates past
+    # the chip's peak FLOPs. Fetching the scalar loss forces the chain.
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, x, y)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return batch_size * steps / dt
+
+
+def cpu_baseline_rate() -> float:
+    if os.path.exists(CACHE):
+        with open(CACHE) as f:
+            return json.load(f)["samples_per_sec"]
+    log("measuring CPU per-worker baseline (one-time, cached)...")
+    code = (
+        "import jax, json, sys;"
+        "jax.config.update('jax_platforms','cpu');"
+        "sys.path.insert(0, %r);"
+        "from bench import measure_train_rate;"
+        "print(json.dumps(measure_train_rate(%d, 3, 1, 'float32')))"
+        % (REPO, BATCH_CPU)
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=1800,
+        cwd=REPO,
+    )
+    if out.returncode != 0:
+        log("CPU baseline failed:", out.stderr[-2000:])
+        raise RuntimeError("cpu baseline subprocess failed")
+    rate = float(out.stdout.strip().splitlines()[-1])
+    with open(CACHE, "w") as f:
+        json.dump({"samples_per_sec": rate, "batch": BATCH_CPU}, f)
+    return rate
+
+
+def main() -> None:
+    import jax
+
+    backend = jax.default_backend()
+    log(f"backend={backend} devices={jax.devices()}")
+    dtype = "bfloat16" if backend == "tpu" else "float32"
+    batch = BATCH_TPU if backend == "tpu" else BATCH_CPU
+    # measure_train_rate pins to a single chip, so its rate IS per-chip.
+    per_chip = measure_train_rate(batch, MEASURE, WARMUP, dtype)
+    log(f"single-chip train rate: {per_chip:.1f} samples/sec")
+
+    try:
+        baseline = cpu_baseline_rate()
+        vs = per_chip / baseline
+        log(f"cpu per-worker baseline: {baseline:.2f} samples/sec -> {vs:.1f}x")
+    except Exception as exc:  # baseline is informative, not load-bearing
+        log("baseline unavailable:", exc)
+        vs = 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "cifar10_resnet18_train_throughput",
+                "value": round(per_chip, 2),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -32,3 +32,5 @@ from elephas_tpu.api.spark_model import (  # noqa: F401
 from elephas_tpu.api.compile import CompiledModel, compile_model  # noqa: F401
 from elephas_tpu.data.rdd import ShardedDataset, to_simple_rdd  # noqa: F401
 from elephas_tpu.data.dataframe import DataFrame  # noqa: F401
+from elephas_tpu.ml import ElephasEstimator, ElephasTransformer  # noqa: F401
+from elephas_tpu.hyperparam import HyperParamModel, hp  # noqa: F401

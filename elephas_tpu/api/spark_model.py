@@ -146,6 +146,7 @@ class TpuModel:
         verbose: int = 0,
         validation_split: float = 0.0,
         validation_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        callbacks=(),
     ) -> Dict[str, List[float]]:
         """Train on a ShardedDataset (or ``(x, y)``), reference §3.1/§3.2."""
         batch_size = batch_size or self.batch_size
@@ -174,6 +175,7 @@ class TpuModel:
                 batch_size=batch_size,
                 validation_data=validation_data,
                 verbose=verbose,
+                callbacks=callbacks,
             )
             self._sync_trainer = trainer
         else:
@@ -193,6 +195,7 @@ class TpuModel:
                 batch_size=batch_size,
                 validation_data=validation_data,
                 verbose=verbose,
+                callbacks=callbacks,
             )
             self._sync_trainer = None
 
@@ -296,6 +299,8 @@ class SparkMLlibModel(TpuModel):
         validation_split: float = 0.0,
         categorical: bool = False,
         nb_classes: Optional[int] = None,
+        validation_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+        callbacks=(),
     ):
         dataset = lp_to_simple_rdd(
             labeled_points,
@@ -309,4 +314,6 @@ class SparkMLlibModel(TpuModel):
             batch_size=batch_size,
             verbose=verbose,
             validation_split=validation_split,
+            validation_data=validation_data,
+            callbacks=callbacks,
         )

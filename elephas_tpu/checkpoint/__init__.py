@@ -1,0 +1,17 @@
+"""Checkpoint / resume (SURVEY.md §5.4).
+
+The reference only saves the final Keras HDF5 after ``fit``
+(``SparkModel.save``) — no mid-training checkpointing, no optimizer
+state. The rebuild keeps that API (in ``api.spark_model``) and adds the
+one thing TPU users actually need (SURVEY.md §5.3): periodic
+``{params, opt_state, batch_stats, step}`` snapshots via Orbax so a
+restarted job resumes — Spark's task-retry safety net does not exist on
+TPU pods, so this is the honest replacement for the reference's
+delegation to Spark fault tolerance.
+"""
+
+from elephas_tpu.checkpoint.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    restore_train_state,
+    save_train_state,
+)

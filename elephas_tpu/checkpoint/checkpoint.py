@@ -1,0 +1,73 @@
+"""Orbax-backed train-state checkpointing.
+
+Layout: ``<directory>/<step>/`` per snapshot (Orbax CheckpointManager
+with rotation). Multi-host: Orbax coordinates per-process writes itself;
+callers only need every process to call save/restore collectively.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import orbax.checkpoint as ocp
+
+from elephas_tpu.engine.state import TrainState
+
+
+class CheckpointManager:
+    """Rotating snapshot manager + fit-callback factory."""
+
+    def __init__(self, directory: str, keep: int = 3, save_every_epochs: int = 1):
+        self.directory = os.path.abspath(directory)
+        self.save_every = max(1, save_every_epochs)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
+        )
+
+    def save(self, state: TrainState, step: Optional[int] = None) -> None:
+        step = int(state.step) if step is None else int(step)
+        self._manager.save(step, args=ocp.args.StandardSave(state))
+        self._manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def restore(self, target: TrainState, step: Optional[int] = None) -> TrainState:
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        return self._manager.restore(step, args=ocp.args.StandardRestore(target))
+
+    def callback(self):
+        """An ``(epoch, state, metrics)`` callback for trainer ``fit``."""
+
+        def cb(epoch: int, state: TrainState, metrics: dict) -> None:
+            if (epoch + 1) % self.save_every == 0:
+                self.save(state)
+
+        return cb
+
+    def close(self) -> None:
+        self._manager.close()
+
+
+def save_train_state(directory: str, state: TrainState, step: Optional[int] = None) -> None:
+    """One-shot save (no rotation bookkeeping)."""
+    ckptr = ocp.StandardCheckpointer()
+    step = int(state.step) if step is None else int(step)
+    ckptr.save(os.path.join(os.path.abspath(directory), str(step)), state, force=True)
+    ckptr.wait_until_finished()
+
+
+def restore_train_state(directory: str, target: TrainState, step: Optional[int] = None) -> TrainState:
+    """One-shot restore; picks the highest-numbered step if unspecified."""
+    directory = os.path.abspath(directory)
+    if step is None:
+        steps = [int(d) for d in os.listdir(directory) if d.isdigit()]
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+        step = max(steps)
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.join(directory, str(step)), target)

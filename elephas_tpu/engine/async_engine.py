@@ -79,6 +79,7 @@ class AsyncTrainer:
         validation_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         verbose: int = 0,
         rng: Optional[jax.Array] = None,
+        callbacks=(),
     ) -> Tuple[TrainState, Dict[str, List[float]]]:
         compiled = self.compiled
         store0 = {"params": compiled.params, "batch_stats": compiled.batch_stats}
@@ -93,11 +94,41 @@ class AsyncTrainer:
 
         per_worker_metrics: List[List[Dict[str, float]]] = [None] * self.n_workers
         errors: List[BaseException] = []
+        # Epoch-barrier bookkeeping for callbacks: fire callback(e, ...) once
+        # the *slowest* worker has finished epoch e (workers never block on
+        # each other — the barrier is observational only).
+        epoch_done_counts = [0] * epochs
+        epochs_fired = 0
+        barrier_lock = threading.Lock()
+
+        def on_epoch_done(epoch: int) -> None:
+            nonlocal epochs_fired
+            if not callbacks:
+                return
+            fire = None
+            with barrier_lock:
+                epoch_done_counts[epoch] += 1
+                if (
+                    epoch == epochs_fired
+                    and epoch_done_counts[epoch] == self.n_workers
+                ):
+                    fire = epoch
+                    epochs_fired += 1
+            if fire is not None:
+                snapshot = jax.device_get(server.get_parameters())
+                snap_state = TrainState.create(
+                    params=snapshot["params"],
+                    opt_state=compiled.init_opt_state(snapshot["params"]),
+                    batch_stats=snapshot["batch_stats"],
+                )
+                for cb in callbacks:
+                    cb(fire, snap_state, {})
 
         def worker(index: int, device: jax.Device) -> None:
             try:
                 per_worker_metrics[index] = self._run_worker(
-                    index, device, server, dataset, epochs, batch_size
+                    index, device, server, dataset, epochs, batch_size,
+                    on_epoch_done=on_epoch_done,
                 )
             except BaseException as exc:  # surfaced after join
                 errors.append(exc)
@@ -147,7 +178,14 @@ class AsyncTrainer:
     # -------------------------------------------------------------------------
 
     def _run_worker(
-        self, index: int, device: jax.Device, server, dataset, epochs: int, batch_size: int
+        self,
+        index: int,
+        device: jax.Device,
+        server,
+        dataset,
+        epochs: int,
+        batch_size: int,
+        on_epoch_done=None,
     ) -> List[Dict[str, float]]:
         compiled = self.compiled
         client = server.client()
@@ -222,6 +260,8 @@ class AsyncTrainer:
                         for k in batch_dicts[0]
                     }
                 )
+            if on_epoch_done is not None:
+                on_epoch_done(epoch)
         if hasattr(client, "close"):
             client.close()
         return epoch_metrics

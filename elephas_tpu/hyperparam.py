@@ -1,0 +1,186 @@
+"""Distributed hyperparameter search.
+
+Reference: ``elephas/hyperparam.py::HyperParamModel`` (SURVEY.md §2.1,
+§3.4): hyperas parses a templated model function, ``sc.parallelize``
+fans independent ``hyperopt.fmin`` runs out across executors — *search-
+space partitioning*, not coordinated Bayesian optimization (each worker
+keeps its own ``Trials()``), and the driver picks the argmin.
+
+TPU-native redesign: hyperas/hyperopt don't exist here, so the search
+space is declared with the ``hp`` combinators below and the objective is
+a plain callable. Trials stay embarrassingly parallel with *independent
+per-worker streams* (the reference's exact semantic, including its
+limitation — documented, not "fixed"): one host thread per chip, each
+thread pinning its trials to its device via ``jax.default_device``. On
+multi-host pods each host runs its own ``HyperParamModel`` over its
+local chips (SURVEY.md §7 step 6).
+
+Objective contract (hyperopt-compatible):
+    ``model_fn(sample: dict, data) -> {"loss": float, "model": CompiledModel,
+    "status": "ok"}``  — extra keys are kept and returned with the trial.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["hp", "HyperParamModel", "sample_space"]
+
+
+class _Dist:
+    def sample(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+
+class _Choice(_Dist):
+    def __init__(self, options):
+        self.options = list(options)
+
+    def sample(self, rng):
+        return self.options[rng.integers(len(self.options))]
+
+
+class _Uniform(_Dist):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return float(rng.uniform(self.low, self.high))
+
+
+class _LogUniform(_Dist):
+    def __init__(self, low, high):
+        # hyperopt convention: bounds are on log(value).
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return float(np.exp(rng.uniform(self.low, self.high)))
+
+
+class _QUniform(_Dist):
+    def __init__(self, low, high, q):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        return float(np.round(rng.uniform(self.low, self.high) / self.q) * self.q)
+
+
+class _RandInt(_Dist):
+    def __init__(self, upper):
+        self.upper = upper
+
+    def sample(self, rng):
+        return int(rng.integers(self.upper))
+
+
+class hp:
+    """hyperopt-flavored search-space combinators."""
+
+    choice = _Choice
+    uniform = _Uniform
+    loguniform = _LogUniform
+    quniform = _QUniform
+    randint = _RandInt
+
+
+def sample_space(space: Any, rng: np.random.Generator) -> Any:
+    """Recursively sample every ``hp.*`` node in a nested dict/list/tuple."""
+    if isinstance(space, _Dist):
+        return space.sample(rng)
+    if isinstance(space, dict):
+        return {k: sample_space(v, rng) for k, v in space.items()}
+    if isinstance(space, (list, tuple)):
+        return type(space)(sample_space(v, rng) for v in space)
+    return space
+
+
+class HyperParamModel:
+    """Distributed random search with per-worker independent streams.
+
+    Constructor mirrors the reference (``HyperParamModel(sc, num_workers)``);
+    ``sc`` is accepted-and-ignored (no Spark driver).
+    """
+
+    def __init__(self, sc=None, num_workers: Optional[int] = None):
+        del sc
+        n_devices = len(jax.devices())
+        self.num_workers = min(num_workers or n_devices, n_devices)
+        self.best_models: List[Dict] = []  # per-worker bests (reference attr)
+
+    def minimize(
+        self,
+        model: Callable,
+        data: Callable,
+        max_evals: int = 10,
+        space: Optional[Dict] = None,
+        seed: int = 0,
+    ):
+        """Run ``max_evals`` trials split across workers; return the best
+        trial dict (``{"loss", "model", "sample", ...}``).
+
+        ``model``: objective ``(sample, data) -> {"loss", "model", ...}``.
+        ``data``: zero-arg callable returning the dataset given to every
+        trial (the reference's hyperas ``data`` function).
+        """
+        if space is None:
+            space = {}
+        dataset = data() if callable(data) else data
+        # Exactly max_evals trials total: worker i takes the remainder's
+        # i-th extra trial (idle workers get zero).
+        base, extra = divmod(max_evals, self.num_workers)
+        trials_for = [base + (1 if i < extra else 0) for i in range(self.num_workers)]
+        devices = jax.devices()[: self.num_workers]
+        results: List[List[Dict]] = [[] for _ in range(self.num_workers)]
+        errors: List[BaseException] = []
+
+        def worker(index: int, device) -> None:
+            # Independent stream per worker — the reference's independent
+            # Trials() semantics (§3.4 note).
+            rng = np.random.default_rng(seed * 10_007 + index)
+            try:
+                with jax.default_device(device):
+                    for trial in range(trials_for[index]):
+                        sample = sample_space(space, rng)
+                        out = model(sample, dataset)
+                        if not isinstance(out, dict) or "loss" not in out:
+                            raise TypeError(
+                                "objective must return a dict with a 'loss' key"
+                            )
+                        out.setdefault("status", "ok")
+                        out["sample"] = sample
+                        out["worker"] = index
+                        out["trial"] = trial
+                        results[index].append(out)
+            except BaseException as exc:
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=worker, args=(i, dev), daemon=True)
+            for i, dev in enumerate(devices)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+        self.best_models = [
+            min(worker_results, key=lambda r: r["loss"])
+            for worker_results in results
+            if worker_results
+        ]
+        if not self.best_models:
+            raise RuntimeError("no trials completed")
+        return min(self.best_models, key=lambda r: r["loss"])
+
+    def best_model(self):
+        """Best model object across workers (reference convenience)."""
+        if not self.best_models:
+            raise RuntimeError("call minimize() first")
+        best = min(self.best_models, key=lambda r: r["loss"])
+        return best.get("model")

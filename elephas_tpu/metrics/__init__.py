@@ -1,0 +1,9 @@
+"""Metrics / logging / observability (SURVEY.md §5.5) and profiling hooks
+(SURVEY.md §5.1 — the reference has neither; users got the Spark web UI)."""
+
+from elephas_tpu.metrics.logging import (  # noqa: F401
+    JsonlSink,
+    Throughput,
+    host0_logger,
+    trace,
+)

@@ -1,0 +1,119 @@
+"""Step metrics, JSONL sink, throughput meter, profiler hooks.
+
+SURVEY.md §5.1/§5.5: the reference ships no tracing or metrics backend —
+Keras progress bars die in executor logs. The rebuild provides the three
+primitives its benchmark and users need:
+
+- ``host0_logger``      — a logger that is silent on non-zero hosts,
+- ``JsonlSink``         — append-only structured metrics (one JSON/line),
+- ``Throughput``        — honest samples/sec walls (``block_until_ready``),
+- ``trace``             — context manager around ``jax.profiler`` traces
+                          (TensorBoard/Perfetto viewable).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from typing import Optional
+
+import jax
+
+
+def host0_logger(name: str = "elephas_tpu", level: int = logging.INFO) -> logging.Logger:
+    """Process-0-only logger (every host logging identically is noise)."""
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if jax.process_index() != 0:
+        logger.addHandler(logging.NullHandler())
+        logger.propagate = False
+    return logger
+
+
+class JsonlSink:
+    """Append-only JSONL metrics file, written by host 0 only."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._active = jax.process_index() == 0
+        self._file = open(path, "a") if self._active else None
+
+    def log(self, step: int, **metrics) -> None:
+        if not self._active:
+            return
+        record = {"step": int(step), "time": time.time()}
+        for key, value in metrics.items():
+            try:
+                record[key] = float(value)
+            except (TypeError, ValueError):
+                record[key] = value
+        # Metrics hooks must degrade, not kill the training loop: stringify
+        # anything json can't carry (arrays, pytrees, ...).
+        try:
+            line = json.dumps(record)
+        except TypeError:
+            safe = {
+                k: v if isinstance(v, (int, float, str, bool, type(None))) else str(v)
+                for k, v in record.items()
+            }
+            line = json.dumps(safe)
+        self._file.write(line + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Throughput:
+    """samples/sec meter with device-sync walls.
+
+    Usage::
+
+        meter = Throughput()
+        meter.start()                       # blocks on `wall` if given
+        ... run steps, meter.add(n_samples)
+        rate = meter.rate(wall=last_output)  # blocks until ready
+    """
+
+    def __init__(self):
+        self._t0: Optional[float] = None
+        self._samples = 0
+
+    def start(self, wall=None) -> None:
+        if wall is not None:
+            jax.block_until_ready(wall)
+        self._samples = 0
+        self._t0 = time.perf_counter()
+
+    def add(self, n_samples: int) -> None:
+        self._samples += int(n_samples)
+
+    def elapsed(self, wall=None) -> float:
+        if self._t0 is None:
+            raise RuntimeError("call start() first")
+        if wall is not None:
+            jax.block_until_ready(wall)
+        return time.perf_counter() - self._t0
+
+    def rate(self, wall=None) -> float:
+        return self._samples / max(self.elapsed(wall), 1e-9)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """``jax.profiler`` trace window (view in TensorBoard/Perfetto)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

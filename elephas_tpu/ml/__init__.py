@@ -1,0 +1,8 @@
+"""ML-pipeline façade (reference L5: ``elephas/ml_model.py`` + ``elephas/ml/``)."""
+
+from elephas_tpu.ml.ml_model import (  # noqa: F401
+    ElephasEstimator,
+    ElephasTransformer,
+    load_ml_estimator,
+    load_ml_transformer,
+)

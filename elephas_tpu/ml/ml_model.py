@@ -1,0 +1,235 @@
+"""Estimator/Transformer pipeline stages over columnar DataFrames.
+
+Reference: ``elephas/ml_model.py::{ElephasEstimator, ElephasTransformer,
+load_ml_estimator, load_ml_transformer}`` (SURVEY.md §2.1, §3.3):
+DataFrame in / DataFrame out, making distributed training a
+``Pipeline`` stage with save/load. The pyspark.ml machinery is replaced
+by the dependency-free ``Has*`` mixins in ``elephas_tpu.ml.params`` and
+the columnar ``DataFrame`` in ``elephas_tpu.data.dataframe``; training
+itself delegates to ``SparkModel`` exactly like the reference (§3.3
+call stack: estimator -> df_to_simple_rdd -> SparkModel.fit ->
+transformer with trained weights).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from elephas_tpu.api.spark_model import SparkModel
+from elephas_tpu.data.dataframe import DataFrame, df_to_simple_rdd
+from elephas_tpu.ml.params import (
+    HasBatchSize,
+    HasCategoricalLabels,
+    HasEpochs,
+    HasFeaturesCol,
+    HasFrequency,
+    HasKerasModelConfig,
+    HasLabelCol,
+    HasLoss,
+    HasMetrics,
+    HasMode,
+    HasNumberOfClasses,
+    HasNumberOfWorkers,
+    HasOptimizerConfig,
+    HasOutputCol,
+    HasParameterServerMode,
+    HasValidationSplit,
+    HasVerbosity,
+)
+from elephas_tpu.serialize.serialization import dict_to_model, model_to_dict
+
+
+class ElephasEstimator(
+    HasKerasModelConfig,
+    HasMode,
+    HasFrequency,
+    HasNumberOfClasses,
+    HasNumberOfWorkers,
+    HasEpochs,
+    HasBatchSize,
+    HasVerbosity,
+    HasValidationSplit,
+    HasCategoricalLabels,
+    HasLoss,
+    HasMetrics,
+    HasOptimizerConfig,
+    HasOutputCol,
+    HasFeaturesCol,
+    HasLabelCol,
+    HasParameterServerMode,
+):
+    """Trainable pipeline stage: ``fit(df) -> ElephasTransformer``.
+
+    ``keras_model_config`` accepts either a ``model_to_dict`` payload or a
+    registry config ``{"name": ..., "kwargs": ...}`` (the TPU-native
+    analogue of the reference's Keras arch JSON string).
+    """
+
+    def __init__(self, **kwargs):
+        self.set_params(**kwargs)
+
+    def _build_model(self):
+        config = self.keras_model_config
+        if config is None:
+            raise ValueError("set_keras_model_config(...) before fit")
+        if "arch" in config:  # full model_to_dict payload
+            compiled = dict_to_model(config)
+            # Stage params override the payload's training attributes.
+            from elephas_tpu.api.compile import CompiledModel
+
+            compiled = CompiledModel(
+                compiled.module,
+                params=compiled.params,
+                optimizer=self.optimizer_config,
+                loss=self.loss,
+                metrics=list(self.metrics),
+                batch_stats=compiled.batch_stats,
+                model_config=compiled.model_config,
+            )
+            return compiled
+        # registry config
+        from elephas_tpu.api.compile import CompiledModel
+        from elephas_tpu.models import get_model
+
+        module = get_model(config["name"], **config.get("kwargs", {}))
+        input_shape = config.get("input_shape")
+        if input_shape is None:
+            raise ValueError(
+                "registry keras_model_config needs 'input_shape' to initialize"
+            )
+        return CompiledModel(
+            module,
+            optimizer=self.optimizer_config,
+            loss=self.loss,
+            metrics=list(self.metrics),
+            input_shape=tuple(input_shape),
+            input_dtype=np.dtype(config.get("input_dtype", "float32")),
+        )
+
+    def _fit(self, df: DataFrame) -> "ElephasTransformer":
+        compiled = self._build_model()
+        rdd = df_to_simple_rdd(
+            df,
+            categorical=self.categorical,
+            nb_classes=self.nb_classes,
+            features_col=self.features_col,
+            label_col=self.label_col,
+            num_partitions=self.num_workers or 1,
+        )
+        spark_model = SparkModel(
+            compiled,
+            mode=self.mode,
+            frequency=self.frequency,
+            parameter_server_mode=self.parameter_server_mode,
+            num_workers=self.num_workers,
+            batch_size=self.batch_size,
+        )
+        spark_model.fit(
+            rdd,
+            epochs=self.epochs,
+            batch_size=self.batch_size,
+            verbose=self.verbose,
+            validation_split=self.validation_split,
+        )
+        return ElephasTransformer(
+            model_payload=model_to_dict(spark_model.master_network),
+            output_col=self.output_col,
+            features_col=self.features_col,
+            categorical=self.categorical,
+            history=spark_model.training_histories[-1],
+        )
+
+    # pyspark.ml parity: public fit() delegates to _fit().
+    def fit(self, df: DataFrame) -> "ElephasTransformer":
+        return self._fit(df)
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({"kind": "estimator", "params": self.param_map()}, f)
+
+
+class ElephasTransformer(HasOutputCol, HasFeaturesCol, HasCategoricalLabels):
+    """Fitted stage: ``transform(df)`` appends a prediction column.
+
+    Reference §3.3: broadcast weights, mapPartitions predict, re-attach
+    column — here a sharded jit forward over the mesh via SparkModel.
+    """
+
+    def __init__(
+        self,
+        model_payload: dict,
+        output_col: str = "prediction",
+        features_col: str = "features",
+        categorical: bool = True,
+        history: Optional[dict] = None,
+    ):
+        self.model_payload = model_payload
+        self.set_output_col(output_col)
+        self.set_features_col(features_col)
+        self.set_categorical(categorical)
+        self.history = history or {}
+        self._spark_model: Optional[SparkModel] = None
+
+    def get_model(self):
+        """The trained CompiledModel (reference ``Transformer.get_model``)."""
+        return self._model().master_network
+
+    def _model(self) -> SparkModel:
+        if self._spark_model is None:
+            self._spark_model = SparkModel(
+                dict_to_model(self.model_payload), mode="synchronous"
+            )
+        return self._spark_model
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        features = df[self.features_col]
+        outputs = self._model().predict(features)
+        if self.categorical:
+            predictions = np.argmax(outputs, axis=-1).astype(np.float32)
+        elif outputs.ndim > 1 and outputs.shape[-1] == 1:
+            predictions = np.squeeze(outputs, axis=-1)  # keep the row dim
+        else:
+            predictions = outputs
+        return df.with_column(self.output_col, predictions)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return self._transform(df)
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump(
+                {
+                    "kind": "transformer",
+                    "model_payload": self.model_payload,
+                    "output_col": self.output_col,
+                    "features_col": self.features_col,
+                    "categorical": self.categorical,
+                    "history": self.history,
+                },
+                f,
+            )
+
+
+def load_ml_estimator(path: str) -> ElephasEstimator:
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("kind") != "estimator":
+        raise ValueError(f"{path} does not contain an ElephasEstimator")
+    return ElephasEstimator(**payload["params"])
+
+
+def load_ml_transformer(path: str) -> ElephasTransformer:
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("kind") != "transformer":
+        raise ValueError(f"{path} does not contain an ElephasTransformer")
+    return ElephasTransformer(
+        model_payload=payload["model_payload"],
+        output_col=payload["output_col"],
+        features_col=payload["features_col"],
+        categorical=payload["categorical"],
+        history=payload["history"],
+    )

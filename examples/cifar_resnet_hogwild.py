@@ -1,0 +1,39 @@
+"""BASELINE config 4: CIFAR-10 ResNet-18, mode=hogwild (the primary
+benchmark workload — see bench.py for the throughput harness)."""
+
+import numpy as np
+
+from elephas_tpu import SparkModel, compile_model, to_simple_rdd
+from elephas_tpu.models import get_model
+
+
+def synthetic_cifar(n=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    prototypes = rng.normal(scale=1.5, size=(10, 32, 32, 3))
+    labels = rng.integers(0, 10, size=n)
+    x = prototypes[labels] + rng.normal(size=(n, 32, 32, 3))
+    return x.astype(np.float32), np.eye(10, dtype=np.float32)[labels]
+
+
+def main():
+    x, y = synthetic_cifar()
+    net = compile_model(
+        get_model("resnet18", num_classes=10, dtype="bfloat16"),
+        optimizer={"name": "momentum", "learning_rate": 0.1},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(32, 32, 3),
+    )
+    model = SparkModel(
+        net,
+        mode="hogwild",           # lock-free Downpour (Hogwild!)
+        frequency="epoch",
+        parameter_server_mode="local",
+        num_workers=4,
+    )
+    history = model.fit(to_simple_rdd(None, x, y, 4), epochs=3, batch_size=128, verbose=1)
+    print("eval:", model.evaluate(x, y, batch_size=512))
+
+
+if __name__ == "__main__":
+    main()

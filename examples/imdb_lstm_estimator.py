@@ -1,0 +1,56 @@
+"""BASELINE config 3: IMDB LSTM via the ElephasEstimator pipeline.
+
+Reference workflow (§3.3): DataFrame -> Estimator.fit -> Transformer ->
+DataFrame with predictions. Synthetic IMDB-shaped data: token sequences
+(vocab 2000, len 100), binary sentiment driven by planted token stats.
+"""
+
+import numpy as np
+
+from elephas_tpu import ElephasEstimator
+from elephas_tpu.data.dataframe import DataFrame
+
+
+def synthetic_imdb(n=2048, vocab=2000, seq_len=100, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 2, size=n)
+    # Positive reviews skew toward the upper half of the vocab.
+    low = rng.integers(1, vocab // 2, size=(n, seq_len))
+    high = rng.integers(vocab // 2, vocab, size=(n, seq_len))
+    mask = rng.random((n, seq_len)) < (0.35 + 0.3 * labels)[:, None]
+    tokens = np.where(mask, high, low).astype(np.int32)
+    return tokens, labels.astype(np.float32)
+
+
+def main():
+    tokens, labels = synthetic_imdb()
+    df = DataFrame({"features": tokens, "label": labels})
+
+    estimator = ElephasEstimator(
+        keras_model_config={
+            "name": "lstm",
+            "kwargs": {"vocab_size": 2000, "embed_dim": 64, "hidden_dim": 64,
+                        "num_classes": 2},
+            "input_shape": (100,),
+            "input_dtype": "int32",
+        },
+        mode="synchronous",
+        frequency="batch",
+        nb_classes=2,
+        num_workers=4,
+        epochs=3,
+        batch_size=32,
+        optimizer_config={"name": "adam", "learning_rate": 1e-3},
+        loss="categorical_crossentropy",
+        metrics=("acc",),
+        categorical=True,
+    )
+    transformer = estimator.fit(df)
+    out = transformer.transform(df)
+    acc = float(np.mean(out["prediction"] == df["label"]))
+    print(f"pipeline accuracy: {acc:.3f}")
+    transformer.save("/tmp/imdb_lstm_transformer.pkl")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""BASELINE config 2: MNIST CNN via SparkModel (asynchronous Downpour SGD)."""
+
+import numpy as np
+
+from elephas_tpu import SparkModel, compile_model, to_simple_rdd
+from elephas_tpu.models import get_model
+
+
+def synthetic_mnist_images(n=8192, seed=0):
+    rng = np.random.default_rng(seed)
+    prototypes = rng.normal(scale=2.0, size=(10, 28, 28, 1))
+    labels = rng.integers(0, 10, size=n)
+    x = prototypes[labels] + rng.normal(size=(n, 28, 28, 1))
+    return x.astype(np.float32), np.eye(10, dtype=np.float32)[labels]
+
+
+def main():
+    x, y = synthetic_mnist_images()
+    net = compile_model(
+        get_model("cnn", channels=(32, 64), dense_width=128, num_classes=10),
+        optimizer={"name": "adam", "learning_rate": 1e-3},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(28, 28, 1),
+    )
+    model = SparkModel(
+        net,
+        mode="asynchronous",      # Downpour SGD
+        frequency="epoch",        # pull/push once per local epoch
+        parameter_server_mode="local",  # HBM-resident buffer; 'http'/'socket' for multi-host
+        num_workers=4,
+    )
+    history = model.fit(to_simple_rdd(None, x, y, 4), epochs=5, batch_size=64, verbose=1)
+    print("eval:", model.evaluate(x, y))
+
+
+if __name__ == "__main__":
+    main()

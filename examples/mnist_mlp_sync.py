@@ -1,0 +1,40 @@
+"""BASELINE config 1: MNIST MLP via SparkModel (synchronous, 4 partitions).
+
+Mirrors the reference's ``examples/mnist_mlp_spark.py`` workflow. The
+environment has no network access, so data is synthetic MNIST-shaped
+(28x28 grayscale, 10 classes); swap ``synthetic_mnist`` for a real loader
+when one is available.
+"""
+
+import numpy as np
+
+from elephas_tpu import SparkModel, compile_model, to_simple_rdd
+from elephas_tpu.models import get_model
+
+
+def synthetic_mnist(n=8192, seed=0):
+    rng = np.random.default_rng(seed)
+    prototypes = rng.normal(scale=2.0, size=(10, 28 * 28))
+    labels = rng.integers(0, 10, size=n)
+    x = prototypes[labels] + rng.normal(size=(n, 28 * 28))
+    return x.astype(np.float32).reshape(n, 28, 28), np.eye(10, dtype=np.float32)[labels]
+
+
+def main():
+    x, y = synthetic_mnist()
+    net = compile_model(
+        get_model("mlp", features=(128, 128), num_classes=10, dropout_rate=0.1),
+        optimizer={"name": "adam", "learning_rate": 1e-3},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(28, 28),
+    )
+    model = SparkModel(net, mode="synchronous", frequency="batch", num_workers=4)
+    rdd = to_simple_rdd(None, x, y, num_partitions=4)
+    history = model.fit(rdd, epochs=5, batch_size=32, validation_split=0.1, verbose=1)
+    print("final:", {k: round(v[-1], 4) for k, v in history.items()})
+    model.save("/tmp/mnist_mlp_sync.pkl")
+
+
+if __name__ == "__main__":
+    main()

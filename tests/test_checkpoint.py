@@ -1,0 +1,94 @@
+"""Checkpoint/resume tests (SURVEY.md §5.4 upgrade: mid-training snapshots)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from elephas_tpu import SparkModel, to_simple_rdd
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.checkpoint import CheckpointManager, restore_train_state, save_train_state
+from elephas_tpu.engine.step import init_train_state
+from elephas_tpu.models import get_model
+
+from conftest import make_blobs
+
+
+def _compiled(seed=0):
+    return CompiledModel(
+        get_model("mlp", features=(16,), num_classes=3),
+        optimizer={"name": "adam", "learning_rate": 0.01},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(8,),
+        seed=seed,
+    )
+
+
+def test_one_shot_save_restore(tmp_path):
+    compiled = _compiled()
+    state = init_train_state(compiled)
+    state = state.replace(step=state.step + 7)
+    save_train_state(str(tmp_path), state)
+    target = init_train_state(_compiled(seed=9))  # different weights
+    restored = restore_train_state(str(tmp_path), target)
+    assert int(restored.step) == 7
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params), jax.tree_util.tree_leaves(restored.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_missing_raises(tmp_path):
+    target = init_train_state(_compiled())
+    with pytest.raises(FileNotFoundError):
+        restore_train_state(str(tmp_path / "empty"), target)
+
+
+def test_manager_rotation_and_latest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    compiled = _compiled()
+    state = init_train_state(compiled)
+    for step in (1, 2, 3):
+        mgr.save(state, step=step)
+    assert mgr.latest_step() == 3
+    kept = sorted(int(d) for d in os.listdir(tmp_path) if d.isdigit())
+    assert len(kept) <= 2 and 3 in kept  # rotation dropped the oldest
+    restored = mgr.restore(init_train_state(_compiled(seed=4)))
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree_util.tree_leaves(restored.params)[0]),
+        np.asarray(jax.tree_util.tree_leaves(state.params)[0]),
+    )
+    mgr.close()
+
+
+def test_async_fit_fires_callbacks(tmp_path):
+    """Async/hogwild modes must checkpoint too (epoch completion barrier)."""
+    x, y = make_blobs(n=256, num_classes=3, dim=8, seed=3)
+    model = SparkModel(_compiled(), mode="asynchronous", frequency="epoch", num_workers=2)
+    fired = []
+    model.fit(
+        to_simple_rdd(None, x, y, 2),
+        epochs=3,
+        batch_size=16,
+        callbacks=[lambda epoch, state, metrics: fired.append(epoch)],
+    )
+    assert fired == [0, 1, 2]
+
+
+def test_fit_callback_checkpoints_and_resume(tmp_path):
+    """Snapshots during SparkModel.fit; resumed model predicts identically."""
+    x, y = make_blobs(n=256, num_classes=3, dim=8, seed=2)
+    compiled = _compiled()
+    model = SparkModel(compiled, mode="synchronous", frequency="batch", num_workers=2)
+    mgr = CheckpointManager(str(tmp_path), keep=2, save_every_epochs=1)
+    model.fit(to_simple_rdd(None, x, y, 2), epochs=2, batch_size=16,
+              callbacks=[mgr.callback()])
+    assert mgr.latest_step() is not None
+    # Restore into a fresh state and check weights match the trained master.
+    restored = mgr.restore(init_train_state(_compiled(seed=5)))
+    trained_leaf = jax.tree_util.tree_leaves(model.master_network.params)[0]
+    restored_leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    np.testing.assert_allclose(np.asarray(trained_leaf), np.asarray(restored_leaf), rtol=1e-6)
+    mgr.close()

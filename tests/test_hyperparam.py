@@ -1,0 +1,105 @@
+"""Hyperparameter search tests (reference test_hyperparam.py §4: a tiny
+search completes and returns a model)."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.hyperparam import HyperParamModel, hp, sample_space
+from elephas_tpu.models import get_model
+
+from conftest import make_blobs
+
+
+def test_sample_space_recursive():
+    rng = np.random.default_rng(0)
+    space = {
+        "lr": hp.loguniform(np.log(1e-4), np.log(1e-1)),
+        "width": hp.choice([16, 32]),
+        "layers": [hp.randint(3), "fixed"],
+        "drop": hp.quniform(0.0, 0.5, 0.1),
+    }
+    s = sample_space(space, rng)
+    assert 1e-4 <= s["lr"] <= 1e-1
+    assert s["width"] in (16, 32)
+    assert 0 <= s["layers"][0] < 3 and s["layers"][1] == "fixed"
+    assert abs(s["drop"] * 10 - round(s["drop"] * 10)) < 1e-9
+
+
+def _objective(sample, data):
+    x, y, xv, yv = data
+    compiled = CompiledModel(
+        get_model("mlp", features=(sample["width"],), num_classes=4),
+        optimizer={"name": "adam", "learning_rate": sample["lr"]},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(x.shape[1],),
+    )
+    from elephas_tpu import SparkModel, to_simple_rdd
+
+    model = SparkModel(compiled, mode="synchronous", frequency="batch", num_workers=1)
+    model.fit(to_simple_rdd(None, x, y, 1), epochs=2, batch_size=32)
+    val = model.evaluate(xv, yv)
+    return {"loss": val["loss"], "model": compiled, "val_acc": val["acc"]}
+
+
+def _data():
+    x, y = make_blobs(n=256, num_classes=4, dim=8, seed=11)
+    return x[:192], y[:192], x[192:], y[192:]
+
+
+SPACE = {
+    "lr": hp.choice([1e-2, 1e-3]),
+    "width": hp.choice([16, 32]),
+}
+
+
+def test_minimize_returns_best_trial():
+    search = HyperParamModel(None, num_workers=4)
+    best = search.minimize(_objective, _data, max_evals=4, space=SPACE, seed=1)
+    assert best["status"] == "ok"
+    assert "model" in best and best["sample"]["width"] in (16, 32)
+    assert len(search.best_models) == 4  # one best per worker
+    assert search.best_model() is best["model"]
+    # best is the global argmin over worker bests
+    assert best["loss"] == min(r["loss"] for r in search.best_models)
+
+
+def test_workers_explore_independent_streams():
+    search = HyperParamModel(None, num_workers=4)
+    search.minimize(_objective, _data, max_evals=8, space=SPACE, seed=2)
+    samples = [tuple(sorted(b["sample"].items())) for b in search.best_models]
+    assert len(set(samples)) > 1  # not all workers drew identical samples
+
+
+def test_exact_trial_budget():
+    """minimize runs exactly max_evals trials, remainder spread over workers."""
+    counter = []
+
+    def counting_objective(sample, data):
+        counter.append(1)
+        return {"loss": float(sample["lr"]), "model": None}
+
+    search = HyperParamModel(None, num_workers=4)
+    search.minimize(counting_objective, lambda: None, max_evals=6,
+                    space={"lr": hp.uniform(0, 1)})
+    assert len(counter) == 6
+    counter.clear()
+    search2 = HyperParamModel(None, num_workers=4)
+    search2.minimize(counting_objective, lambda: None, max_evals=2,
+                     space={"lr": hp.uniform(0, 1)})
+    assert len(counter) == 2  # fewer trials than workers: idle workers run 0
+
+
+def test_objective_errors_propagate():
+    def bad_objective(sample, data):
+        return 42  # not a dict
+
+    search = HyperParamModel(None, num_workers=2)
+    with pytest.raises(TypeError):
+        search.minimize(bad_objective, _data, max_evals=2, space=SPACE)
+
+
+def test_best_model_before_minimize_raises():
+    with pytest.raises(RuntimeError):
+        HyperParamModel(None, num_workers=1).best_model()

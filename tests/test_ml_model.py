@@ -1,0 +1,135 @@
+"""Pipeline estimator/transformer tests (reference test_ml_model.py §4:
+full fit/transform + save/load round-trips)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from elephas_tpu.data.dataframe import to_data_frame
+from elephas_tpu.ml import (
+    ElephasEstimator,
+    ElephasTransformer,
+    load_ml_estimator,
+    load_ml_transformer,
+)
+
+from conftest import make_blobs
+
+NUM_CLASSES, DIM = 3, 12
+
+
+@pytest.fixture(scope="module")
+def df():
+    x, y = make_blobs(n=360, num_classes=NUM_CLASSES, dim=DIM, seed=5)
+    return to_data_frame(None, x, y, categorical=True)
+
+
+def make_estimator(**overrides):
+    est = ElephasEstimator(
+        keras_model_config={
+            "name": "mlp",
+            "kwargs": {"features": (24,), "num_classes": NUM_CLASSES},
+            "input_shape": (DIM,),
+        },
+        mode="synchronous",
+        frequency="batch",
+        nb_classes=NUM_CLASSES,
+        num_workers=2,
+        epochs=3,
+        batch_size=16,
+        optimizer_config={"name": "adam", "learning_rate": 0.01},
+        loss="categorical_crossentropy",
+        metrics=("acc",),
+        categorical=True,
+    )
+    est.set_params(**overrides)
+    return est
+
+
+def test_fit_transform_pipeline(df):
+    transformer = make_estimator().fit(df)
+    assert isinstance(transformer, ElephasTransformer)
+    out = transformer.transform(df)
+    assert "prediction" in out.columns
+    acc = float(np.mean(out["prediction"] == df["label"]))
+    assert acc > 0.8
+    assert transformer.history["acc"][-1] > 0.8
+
+
+def test_chainable_setters(df):
+    est = make_estimator()
+    est.set_epochs(2).set_batch_size(8).set_output_col("guess").set_verbose(0)
+    assert est.get_epochs() == 2
+    transformer = est.fit(df)
+    out = transformer.transform(df)
+    assert "guess" in out.columns
+
+
+def test_estimator_save_load_roundtrip(df, tmp_path):
+    est = make_estimator()
+    path = os.path.join(tmp_path, "estimator.pkl")
+    est.save(path)
+    loaded = load_ml_estimator(path)
+    assert loaded.param_map() == est.param_map()
+    transformer = loaded.fit(df)
+    assert transformer.transform(df)["prediction"].shape == (len(df),)
+
+
+def test_transformer_save_load_roundtrip(df, tmp_path):
+    transformer = make_estimator().fit(df)
+    before = transformer.transform(df)["prediction"]
+    path = os.path.join(tmp_path, "transformer.pkl")
+    transformer.save(path)
+    loaded = load_ml_transformer(path)
+    after = loaded.transform(df)["prediction"]
+    np.testing.assert_array_equal(before, after)
+
+
+def test_get_model_returns_trained_network(df):
+    transformer = make_estimator().fit(df)
+    net = transformer.get_model()
+    assert net.count_params() > 0
+
+
+def test_async_estimator(df):
+    transformer = make_estimator(mode="asynchronous", frequency="epoch").fit(df)
+    out = transformer.transform(df)
+    acc = float(np.mean(out["prediction"] == df["label"]))
+    assert acc > 0.8
+
+
+def test_param_validation():
+    with pytest.raises(ValueError):
+        ElephasEstimator(bogus_param=1)
+    est = ElephasEstimator()
+    with pytest.raises(ValueError):  # no model config
+        est.fit(to_data_frame(None, np.zeros((8, 2), np.float32), np.zeros(8), False))
+    assert "mode" in est.explain_params()
+
+
+def test_optimizer_config_default_not_shared():
+    """Mutable Param defaults must not alias across stages."""
+    a, b = ElephasEstimator(), ElephasEstimator()
+    a.optimizer_config["learning_rate"] = 123.0
+    assert "learning_rate" not in b.optimizer_config
+    from elephas_tpu.ml.params import HasOptimizerConfig
+
+    assert "learning_rate" not in HasOptimizerConfig._params()["optimizer_config"].default
+
+
+def test_regression_transform_single_row(df):
+    """categorical=False with a 1-row frame must keep the row dimension."""
+    transformer = make_estimator().fit(df)
+    transformer.set_categorical(False)
+    one = df.limit(1)
+    out = transformer.transform(one)
+    assert out[transformer.output_col].shape[0] == 1
+
+
+def test_wrong_kind_load_raises(tmp_path):
+    est = make_estimator()
+    path = os.path.join(tmp_path, "est.pkl")
+    est.save(path)
+    with pytest.raises(ValueError):
+        load_ml_transformer(path)
