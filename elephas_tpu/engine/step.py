@@ -48,9 +48,11 @@ def _metrics_dict(compiled, loss, outputs, y) -> Dict[str, jax.Array]:
 def make_train_step(compiled, pmean_axis: Optional[str] = None) -> Callable:
     """Build ``step(state, x, y) -> (new_state, metrics)`` (uncompiled).
 
-    ``pmean_axis``: if set, gradients and metrics are ``lax.pmean``'d over
-    that mesh axis before the optimizer update — the per-step allreduce
-    that replaces the reference's driver ``collect()`` in lockstep DP.
+    ``pmean_axis``: if set (one axis name or a tuple of them), gradients
+    and metrics are ``lax.pmean``'d over those mesh axes before the
+    optimizer update — the per-step allreduce that replaces the
+    reference's driver ``collect()`` in lockstep DP, and the combined
+    data+seq reduction in sequence-parallel training.
     """
     loss_fn = make_loss_fn(compiled)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)

@@ -51,6 +51,16 @@ class SelfAttention(nn.Module):
             from elephas_tpu.ops.attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
+        elif self.attention == "ring" and not self.is_initializing():
+            # Sequence-parallel: must be called inside shard_map with the
+            # sequence dimension sharded over the 'seq' mesh axis (see
+            # elephas_tpu.parallel.seq_parallel). During module init (which
+            # runs outside shard_map, where the axis is unbound) the dense
+            # path traces instead — attention has no parameters, so the
+            # param structure is identical.
+            from elephas_tpu.parallel.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, causal=True)
         else:
             out = dense_causal_attention(q, k, v)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(x.shape[0], x.shape[1], d_model)
@@ -95,7 +105,19 @@ class TransformerLM(nn.Module):
             nn.initializers.normal(0.02),
             (self.max_seq_len, self.d_model),
         )
-        x = (x + pos[:seq]).astype(self.dtype)
+        if self.attention == "ring" and not self.is_initializing():
+            # Under sequence parallelism `tokens` is the local shard; index
+            # the positional table at global positions.
+            import jax
+
+            from elephas_tpu.parallel.ring_attention import require_seq_axis
+
+            offset = require_seq_axis() * seq
+            x = (x + jax.lax.dynamic_slice_in_dim(pos, offset, seq, axis=0)).astype(
+                self.dtype
+            )
+        else:
+            x = (x + pos[:seq]).astype(self.dtype)
         for _ in range(self.num_layers):
             x = Block(self.num_heads, dtype=self.dtype, attention=self.attention)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x.astype(jnp.float32))

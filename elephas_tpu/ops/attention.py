@@ -72,14 +72,45 @@ def _blockwise_reference(q, k, v, causal: bool, block_q: int, block_k: int):
     return out[:, :, :sq]
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128):
-    """Blockwise attention; Pallas on TPU, XLA blockwise elsewhere."""
+def _forward_impl(q, k, v, causal, block_q, block_k):
     if jax.default_backend() == "tpu":
-        try:
-            from elephas_tpu.ops.attention_pallas import pallas_flash_attention
-        except ImportError:  # kernel module not present on this build
-            pass
-        else:
-            return pallas_flash_attention(q, k, v, causal=causal)
+        from elephas_tpu.ops.attention_pallas import pallas_flash_attention
+
+        return pallas_flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k
+        )
     return _blockwise_reference(q, k, v, causal, block_q, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    return _forward_impl(q, k, v, causal, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    return _forward_impl(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, residuals, g):
+    # Backward via the XLA blockwise path (same numerics as the kernel);
+    # XLA fuses it well enough for training, and it runs on every backend.
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _blockwise_reference(q_, k_, v_, causal, block_q, block_k),
+        q,
+        k,
+        v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512):
+    """Blockwise attention; Pallas forward on TPU, XLA blockwise elsewhere.
+
+    Differentiable (custom VJP). q/k/v: (batch, heads, seq, head_dim).
+    """
+    return _flash(q, k, v, causal, block_q, block_k)

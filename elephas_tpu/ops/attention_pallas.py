@@ -1,0 +1,176 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Blockwise causal softmax attention with *streamed* K/V: the grid is
+(batch*heads, q_tiles, k_tiles) and Pallas pipelines one (block_k,
+head_dim) K/V tile at a time through VMEM, so VMEM holds only the current
+tiles + the (block_q, head_dim) accumulator regardless of sequence length
+— the long-context regime (100k+ tokens) compiles and runs where a
+whole-sequence-resident layout would VMEM-OOM. Running row-max/row-sum
+live in VMEM scratch, which persists across the innermost (k) grid steps
+of a given q tile. Matmuls hit the MXU with f32 accumulation; causal
+tiles above the diagonal are skipped via ``pl.when`` (no FLOPs).
+
+Backward pass: the public ``flash_attention`` wrapper (ops/attention.py)
+wires this forward into a ``jax.custom_vjp`` whose backward re-computes
+via the XLA blockwise implementation.
+
+Follows /opt/skills/guides/pallas_guide.md (grid/BlockSpec pipelining,
+scratch accumulators, 2-D iota, preferred_element_type on MXU matmuls).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+
+
+def _flash_fwd_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    block_q,
+    block_k,
+    seq_len,
+    causal,
+    sm_scale,
+):
+    """Program (b, qi, kj): fold K/V tile kj into q tile qi's accumulator."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: tile kj contributes iff its first key pos <= q tile's last pos.
+    needed = jnp.logical_or(
+        not causal, kj * block_k <= (qi + 1) * block_q - 1
+    )
+
+    @pl.when(needed)
+    def _fold():
+        q = q_ref[0, ...].astype(jnp.float32) * sm_scale  # (block_q, d)
+        k_tile = k_ref[0, ...].astype(jnp.float32)  # (block_k, d)
+        v_tile = v_ref[0, ...].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q,
+            k_tile,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = k_pos < seq_len
+        if causal:
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        scores = jnp.where(valid, scores, _NEG_INF)
+
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - shift)
+        correction = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - shift), 0.0)
+        acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
+            p,
+            v_tile,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        o_ref[0, ...] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512):
+    """q, k, v: (batch, heads, seq, head_dim) -> same-shaped output."""
+    batch, heads, seq_len, head_dim = q.shape
+    sm_scale = 1.0 / (head_dim**0.5)
+
+    block_q = min(block_q, max(seq_len, 8))
+    block_k = min(block_k, max(seq_len, 8))
+    pad_q = (-seq_len) % block_q
+    pad_k = (-seq_len) % block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+
+    bh = batch * heads
+    qp = qp.reshape(bh, qp.shape[2], head_dim)
+    kp = kp.reshape(bh, kp.shape[2], head_dim)
+    vp = vp.reshape(bh, vp.shape[2], head_dim)
+    num_q = qp.shape[1] // block_q
+    num_k = kp.shape[1] // block_k
+
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        seq_len=seq_len,
+        causal=causal,
+        sm_scale=sm_scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, head_dim),
+                lambda b, i, j: (b, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, head_dim),
+                lambda b, i, j: (b, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, head_dim),
+                lambda b, i, j: (b, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, head_dim),
+            lambda b, i, j: (b, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], head_dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),  # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running sum
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * bh * seq_len * seq_len * head_dim * (0.5 if causal else 1.0)),
+            bytes_accessed=int(3 * bh * seq_len * head_dim * q.dtype.itemsize),
+            transcendentals=int(bh * seq_len * seq_len),
+        ),
+    )(qp, kp, vp)
+    out = out.reshape(batch, heads, -1, head_dim)
+    return out[:, :, :seq_len]

@@ -1,0 +1,121 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh.
+
+Long-context support is absent in the reference (SURVEY.md §5.7 — its
+longest sequence is an IMDB LSTM's few hundred tokens). On TPU, sequences
+longer than one chip's HBM are first-class: shard the sequence over the
+mesh's ``'seq'`` axis and rotate key/value shards around the ring with
+``lax.ppermute`` (ICI neighbor traffic), accumulating each query shard's
+attention with a streaming (online) softmax. After ``seq_size`` steps,
+every query has attended to every key — exact attention, O(local_len²)
+memory, and the permute overlaps with the next chunk's compute.
+
+Usage: inside ``shard_map`` with q/k/v sharded as P(batch?, 'seq', ...)
+on the sequence dimension (see ``ring_self_attention`` and
+``SeqParallelTrainer`` for the wired-up paths).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from elephas_tpu.parallel.mesh import SEQ_AXIS
+
+
+def require_seq_axis(axis_name: str = SEQ_AXIS):
+    """``axis_index`` with an actionable error when called outside shard_map.
+
+    Ring attention only exists relative to a bound mesh axis; calling a
+    ring-configured model on an ordinary (unsharded) path would otherwise
+    surface as a cryptic unbound-axis NameError from deep in tracing.
+    """
+    try:
+        return jax.lax.axis_index(axis_name)
+    except NameError as exc:
+        raise ValueError(
+            f"attention='ring' requires running inside shard_map with a "
+            f"'{axis_name}' mesh axis (see elephas_tpu.parallel.seq_parallel."
+            f"make_lm_train_step). For single-device eval/predict, rebuild "
+            f"the model with attention='dense' or 'flash' — the parameters "
+            f"are identical."
+        ) from exc
+
+
+def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True):
+    """Attention across a sequence-sharded ring.
+
+    q, k, v: local shards of shape (batch, heads, local_len, head_dim);
+    the global sequence is the concatenation of shards in axis order.
+    Returns the local output shard (batch, heads, local_len, head_dim).
+    """
+    my_idx = require_seq_axis(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    b, h, local_len, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    qf = q.astype(jnp.float32) * scale
+
+    q_pos = my_idx * local_len + jnp.arange(local_len)
+
+    # Ring rotation: at step s we hold the k/v shard originally owned by
+    # (my_idx - s) mod n. ppermute sends our current shard to the next
+    # device, so shards travel "forward" while ownership indices walk back.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, s):
+        acc, m, l, k_cur, v_cur = carry
+        owner = (my_idx - s) % n
+        k_pos = owner * local_len + jnp.arange(local_len)
+
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32)
+        )
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]  # (local_q, local_k)
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - shift[..., None])
+        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        l = l * correction + p.sum(axis=-1)
+
+        # Rotate k/v to the next device (skippable on the last step, but a
+        # uniform schedule keeps the collective schedule static).
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m_new, l, k_next, v_next), None
+
+    acc0 = jnp.zeros((b, h, local_len, d), dtype=jnp.float32)
+    m0 = jnp.full((b, h, local_len), -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros((b, h, local_len), dtype=jnp.float32)
+    (acc, _, l, _, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(n)
+    )
+    # Fully-masked rows (none under causal with aligned shards) guard.
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(mesh, q, k, v, causal: bool = True):
+    """Convenience wrapper: shard_map ring attention over ``mesh``'s seq
+    axis. q/k/v are global (batch, heads, seq, head_dim) arrays; sequence
+    must divide evenly by the seq-axis size."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, SEQ_AXIS, None)
+
+    def body(q_, k_, v_):
+        return ring_attention(q_, k_, v_, axis_name=SEQ_AXIS, causal=causal)
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )(q, k, v)

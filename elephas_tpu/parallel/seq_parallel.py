@@ -1,0 +1,81 @@
+"""Sequence-parallel (dp × sp) language-model training.
+
+Composes the two parallelism axes the mesh reserves (SURVEY.md §5.7's
+extension point, made real): batch sharded over ``'data'``, sequence
+sharded over ``'seq'`` with ring attention (``lax.ppermute`` K/V rotation
+over ICI), gradients ``pmean``'d over both axes in one collective. One
+compiled shard_map program per step — the sequence never materializes
+unsharded on any chip, so context length scales with the seq-axis size.
+
+The model must be a ``TransformerLM`` (or compatible) built with
+``attention='ring'`` so its attention rotates K/V and its positional
+embedding indexes global positions. The training step itself is the
+engine's standard ``make_train_step`` (same optimizer/metrics handling as
+every other mode) with a multi-axis pmean — the loss is whatever the
+``CompiledModel`` was compiled with (use
+``loss='sparse_categorical_crossentropy'`` with integer next-token
+targets for LM training).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elephas_tpu.engine.state import TrainState
+from elephas_tpu.engine.step import init_train_state, make_train_step
+from elephas_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, replicated_sharding
+
+
+def make_lm_train_step(compiled, mesh):
+    """Build ``step(state, tokens, targets) -> (state, metrics)``, jitted
+    over ``mesh`` with tokens/targets sharded P('data', 'seq').
+
+    tokens: (batch, seq) int32; targets: whatever ``compiled``'s loss
+    expects per position (next-token ids for the LM losses — callers
+    shift before sharding so shard boundaries stay aligned).
+    """
+    step_fn = make_train_step(compiled, pmean_axis=(DATA_AXIS, SEQ_AXIS))
+
+    def body(state: TrainState, tokens, targets):
+        base_rng = state.rng
+        shard_rng = jax.random.fold_in(
+            jax.random.fold_in(base_rng, jax.lax.axis_index(DATA_AXIS)),
+            jax.lax.axis_index(SEQ_AXIS),
+        )
+        state = state.replace(rng=shard_rng)
+        new_state, metrics = step_fn(state, tokens, targets)
+        # Keep the carried rng replicated across shards.
+        new_state = new_state.replace(
+            rng=jax.random.fold_in(base_rng, new_state.step)
+        )
+        return new_state, metrics
+
+    token_spec = P(DATA_AXIS, SEQ_AXIS)
+    step = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), token_spec, token_spec),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+    return step
+
+
+def shard_lm_batch(mesh, tokens: np.ndarray, targets: np.ndarray) -> Tuple:
+    """Place (batch, seq) token arrays with P('data','seq') sharding."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    return (
+        jax.device_put(np.asarray(tokens), sharding),
+        jax.device_put(np.asarray(targets), sharding),
+    )
+
+
+def init_lm_state(compiled, mesh, rng=None) -> TrainState:
+    state = init_train_state(compiled, rng=rng)
+    return jax.device_put(state, replicated_sharding(mesh))

@@ -1,0 +1,91 @@
+"""dp x sp training tests: ring-attention LM step over a 2x4 mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.models import get_model
+from elephas_tpu.parallel.mesh import build_mesh
+from elephas_tpu.parallel.seq_parallel import (
+    init_lm_state,
+    make_lm_train_step,
+    shard_lm_batch,
+)
+
+VOCAB, SEQ, BATCH = 64, 32, 4
+
+
+def _compiled(attention):
+    return CompiledModel(
+        get_model(
+            "transformer_lm",
+            vocab_size=VOCAB,
+            d_model=32,
+            num_heads=2,
+            num_layers=2,
+            max_seq_len=SEQ,
+            attention=attention,
+        ),
+        optimizer={"name": "adam", "learning_rate": 1e-2},
+        loss="sparse_categorical_crossentropy",
+        metrics=[],
+        input_shape=(SEQ,),
+        input_dtype=jnp.int32,
+        seed=0,
+    )
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, VOCAB, size=(BATCH, SEQ + 1), dtype=np.int32)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def test_seq_parallel_step_runs_and_learns(devices):
+    mesh = build_mesh(num_data=2, num_seq=4)
+    compiled = _compiled("ring")
+    step = make_lm_train_step(compiled, mesh)
+    state = init_lm_state(compiled, mesh)
+    tokens, targets = _data()
+    tokens, targets = shard_lm_batch(mesh, tokens, targets)
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, tokens, targets)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]  # memorizes the fixed batch
+    assert int(state.step) == 10
+
+
+def test_ring_model_outside_shard_map_fails_clearly(devices):
+    import pytest
+
+    compiled = _compiled("ring")
+    with pytest.raises(ValueError, match="attention='ring' requires"):
+        compiled.apply_eval(
+            compiled.params, {}, jnp.zeros((1, SEQ), dtype=jnp.int32)
+        )
+
+
+def test_seq_parallel_matches_single_device_loss(devices):
+    """First-step loss under dp x sp must equal the unsharded dense loss."""
+    mesh = build_mesh(num_data=2, num_seq=4)
+    ring = _compiled("ring")
+    dense = _compiled("dense")
+    # identical init: same seed/arch modulo attention impl
+    tokens_np, targets_np = _data(seed=1)
+
+    step = make_lm_train_step(ring, mesh)
+    state = init_lm_state(ring, mesh)
+    tokens, targets = shard_lm_batch(mesh, tokens_np, targets_np)
+    _, metrics = step(state, tokens, targets)
+    sharded_loss = float(metrics["loss"])
+
+    logits = dense.apply_eval(dense.params, {}, jnp.asarray(tokens_np))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    dense_loss = float(
+        -np.mean(
+            np.take_along_axis(np.asarray(logp), targets_np[..., None], axis=-1)
+        )
+    )
+    np.testing.assert_allclose(sharded_loss, dense_loss, rtol=1e-4)
