@@ -115,14 +115,20 @@ def from_data_frame(
     label_col: str = "label",
 ):
     """DataFrame -> (features, labels) arrays (reference ``from_data_frame``)."""
-    from elephas_tpu.data.rdd import encode_label
-
     features = df[features_col]
     labels = df[label_col]
     if categorical:
+        from elephas_tpu.native import encode_onehot
+
         if nb_classes is None:
             nb_classes = int(labels.max()) + 1
-        labels = np.stack([encode_label(y, nb_classes) for y in labels])
+        int_labels = labels.astype(np.int64)
+        if int_labels.size and (int_labels.min() < 0 or int_labels.max() >= nb_classes):
+            raise ValueError(
+                f"labels outside [0, {nb_classes}): "
+                f"min={int_labels.min()}, max={int_labels.max()}"
+            )
+        labels = encode_onehot(int_labels, nb_classes)
     return features, labels
 
 

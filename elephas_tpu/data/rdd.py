@@ -111,10 +111,12 @@ class ShardedDataset:
 
     def shuffle(self, seed: int = 0) -> "ShardedDataset":
         """Globally permute rows (new dataset, same partitioning)."""
+        from elephas_tpu.native import gather_rows
+
         rng = np.random.default_rng(seed)
         perm = rng.permutation(len(self.features))
-        labels = None if self.labels is None else self.labels[perm]
-        return ShardedDataset(self.features[perm], labels, self.num_partitions)
+        features, labels = gather_rows(self.features, self.labels, perm)
+        return ShardedDataset(features, labels, self.num_partitions)
 
     def take(self, n: int):
         if self.labels is None:
@@ -180,9 +182,17 @@ def from_labeled_point(
     """List of LabeledPoint -> (features, labels) arrays."""
     features = np.stack([np.asarray(lp.features) for lp in lp_list])
     if categorical:
+        from elephas_tpu.native import encode_onehot
+
         if nb_classes is None:
             nb_classes = int(max(lp.label for lp in lp_list)) + 1
-        labels = np.stack([encode_label(lp.label, nb_classes) for lp in lp_list])
+        int_labels = np.array([lp.label for lp in lp_list], dtype=np.int64)
+        if int_labels.size and (int_labels.min() < 0 or int_labels.max() >= nb_classes):
+            raise ValueError(
+                f"labels outside [0, {nb_classes}): "
+                f"min={int_labels.min()}, max={int_labels.max()}"
+            )
+        labels = encode_onehot(int_labels, nb_classes)
     else:
         labels = np.array([lp.label for lp in lp_list], dtype=np.float32)
     return features, labels

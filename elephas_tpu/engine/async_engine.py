@@ -225,11 +225,16 @@ class AsyncTrainer:
             }
             client.update_parameters(delta)
 
+        from elephas_tpu.native import gather_rows
+
         global_step = 0
         for epoch in range(epochs):
             perm = rng_np.permutation(usable)
-            ex = x[perm].reshape(nb, batch_size, *x.shape[1:])
-            ey = y[perm].reshape(nb, batch_size, *y.shape[1:])
+            # n_threads=1: every worker thread gathers concurrently already;
+            # fanning out further would oversubscribe the host CPU.
+            gx, gy = gather_rows(x, y, perm, n_threads=1)
+            ex = gx.reshape(nb, batch_size, *x.shape[1:])
+            ey = gy.reshape(nb, batch_size, *y.shape[1:])
             if self.frequency == "epoch":
                 ex_d = jax.device_put(ex, device)
                 ey_d = jax.device_put(ey, device)

@@ -126,7 +126,7 @@ class SyncTrainer:
                     lambda m: jax.lax.pmean(m, DATA_AXIS), metrics
                 )
             state = state.replace(
-                batch_stats=jax.lax.pmean(state.batch_stats, DATA_AXIS),
+                batch_stats=_pmean_float_leaves(state.batch_stats),
                 rng=jax.random.fold_in(base_rng, epoch_idx + 1),
             )
             epoch_metrics = jax.tree_util.tree_map(lambda m: m.mean(), metrics)
@@ -220,7 +220,7 @@ class SyncTrainer:
             state = state.replace(
                 params=jax.lax.pmean(state.params, DATA_AXIS),
                 opt_state=_pmean_float_leaves(state.opt_state),
-                batch_stats=jax.lax.pmean(state.batch_stats, DATA_AXIS),
+                batch_stats=_pmean_float_leaves(state.batch_stats),
                 rng=jax.random.fold_in(base_rng, epochs),
             )
             per_epoch = jax.tree_util.tree_map(
@@ -296,11 +296,14 @@ class SyncTrainer:
 
 
 def _pmean_float_leaves(tree):
-    """pmean float leaves, leave ints (step counters) alone."""
+    """Re-replicate a pytree across the data axis: float leaves are
+    pmean'd; integer leaves (step counters, Keras seed-generator state)
+    are pmax'd — pmean would silently promote them to float32, and a
+    plain passthrough would leave shard-diverged values unreplicated."""
     return jax.tree_util.tree_map(
         lambda x: jax.lax.pmean(x, DATA_AXIS)
         if jnp.issubdtype(x.dtype, jnp.floating)
-        else x,
+        else jax.lax.pmax(x, DATA_AXIS),
         tree,
     )
 

@@ -1,0 +1,168 @@
+"""Keras-3 (JAX backend) model ingestion.
+
+Reference parity: elephas's entire API surface takes *Keras* models
+(SURVEY.md §0 — ``SparkModel(model, ...)`` with a compiled Keras model).
+The rebuild's first-class citizens are flax modules, but Keras 3 with the
+JAX backend exposes ``stateless_call`` (pure function of explicit
+variables), which maps cleanly onto the engine's functional train step
+(SURVEY.md §7 hard part 2). This bridge adapts a built Keras model to the
+module protocol ``CompiledModel`` expects:
+
+- trainable variables   -> ``params``       (dict ``v0..vN`` of arrays)
+- non-trainable vars    -> ``batch_stats``  (BN stats, seed generators)
+- ``stateless_call(..., training=True)``  -> ``apply_train``
+- ``stateless_call(..., training=False)`` -> ``apply_eval``
+
+Requires ``KERAS_BACKEND=jax`` (set before importing keras). TF/torch
+backends cannot run inside jit and are rejected with a clear error.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class KerasModuleAdapter:
+    """Duck-typed flax-module stand-in wrapping a Keras-3 JAX model."""
+
+    def __init__(self, keras_model):
+        import keras
+
+        if keras.backend.backend() != "jax":
+            raise ValueError(
+                "Keras ingestion needs the JAX backend: set KERAS_BACKEND=jax "
+                f"before importing keras (current backend: {keras.backend.backend()!r})"
+            )
+        if not keras_model.built:
+            raise ValueError(
+                "build the Keras model first (call it once or model.build(shape))"
+            )
+        self._model = keras_model
+
+    # CompiledModel inspects __call__ for the `train` kwarg.
+    def __call__(self, x, train: bool = False):
+        raise NotImplementedError("use init/apply (functional protocol)")
+
+    # -- variable packing ------------------------------------------------------
+
+    def _pack(self, values) -> dict:
+        return {f"v{i}": v for i, v in enumerate(values)}
+
+    def _unpack(self, tree: dict, count: int) -> list:
+        return [tree[f"v{i}"] for i in range(count)]
+
+    @property
+    def _n_trainable(self) -> int:
+        return len(self._model.trainable_variables)
+
+    @property
+    def _n_non_trainable(self) -> int:
+        return len(self._model.non_trainable_variables)
+
+    # -- flax-module protocol --------------------------------------------------
+
+    def init(self, rng, x, train: bool = False) -> dict:
+        del rng, x, train  # Keras already initialized on build
+        variables = {
+            "params": self._pack([v.value for v in self._model.trainable_variables])
+        }
+        if self._n_non_trainable:
+            variables["batch_stats"] = self._pack(
+                [v.value for v in self._model.non_trainable_variables]
+            )
+        return variables
+
+    def apply(self, variables, x, mutable=None, rngs=None, train: bool = False):
+        del rngs  # keras tracks seed-generator state in non-trainables
+        trainable = self._unpack(variables["params"], self._n_trainable)
+        non_trainable = (
+            self._unpack(variables.get("batch_stats", {}), self._n_non_trainable)
+            if self._n_non_trainable
+            else []
+        )
+        outputs, new_non_trainable = self._model.stateless_call(
+            trainable, non_trainable, x, training=train
+        )
+        if mutable:
+            return outputs, {"batch_stats": self._pack(list(new_non_trainable))}
+        return outputs
+
+
+_KERAS_LOSS_NAMES = {
+    "categorical_crossentropy": "categorical_crossentropy",
+    "CategoricalCrossentropy": "categorical_crossentropy",
+    "sparse_categorical_crossentropy": "sparse_categorical_crossentropy",
+    "SparseCategoricalCrossentropy": "sparse_categorical_crossentropy",
+    "binary_crossentropy": "binary_crossentropy",
+    "BinaryCrossentropy": "binary_crossentropy",
+    "mse": "mse",
+    "mean_squared_error": "mse",
+    "MeanSquaredError": "mse",
+    "mae": "mae",
+    "mean_absolute_error": "mae",
+    "MeanAbsoluteError": "mae",
+}
+
+_KERAS_OPTIMIZERS = {"SGD": "sgd", "Adam": "adam", "AdamW": "adamw", "RMSprop": "rmsprop",
+                     "Adagrad": "adagrad"}
+
+
+def _optimizer_from_keras(keras_opt) -> dict:
+    name = _KERAS_OPTIMIZERS.get(type(keras_opt).__name__)
+    if name is None:
+        raise ValueError(
+            f"unmapped Keras optimizer {type(keras_opt).__name__}; pass "
+            "optimizer=... explicitly"
+        )
+    lr = keras_opt.learning_rate
+    try:
+        lr = float(lr.value if hasattr(lr, "value") else lr)
+    except TypeError:  # schedule object
+        lr = float(lr(0))
+    return {"name": name, "learning_rate": lr}
+
+
+def _loss_from_keras(keras_loss) -> str:
+    key = keras_loss if isinstance(keras_loss, str) else type(keras_loss).__name__
+    if key in _KERAS_LOSS_NAMES:
+        return _KERAS_LOSS_NAMES[key]
+    raise ValueError(f"unmapped Keras loss {key!r}; pass loss=... explicitly")
+
+
+def from_keras(
+    keras_model,
+    optimizer=None,
+    loss=None,
+    metrics: Optional[Sequence] = None,
+):
+    """Wrap a built Keras-3 JAX-backend model as a ``CompiledModel``.
+
+    ``optimizer``/``loss``/``metrics`` default from the Keras model's own
+    ``compile(...)`` configuration when present (the reference reads the
+    compiled Keras model the same way).
+    """
+    from elephas_tpu.api.compile import CompiledModel
+
+    adapter = KerasModuleAdapter(keras_model)
+
+    if optimizer is None:
+        if getattr(keras_model, "optimizer", None) is None:
+            raise ValueError("model is not compiled; pass optimizer=...")
+        optimizer = _optimizer_from_keras(keras_model.optimizer)
+    if loss is None:
+        keras_loss = getattr(keras_model, "loss", None)
+        if keras_loss is None:
+            raise ValueError("model is not compiled; pass loss=...")
+        loss = _loss_from_keras(keras_loss)
+    if metrics is None:
+        metrics = ["acc"] if "crossentropy" in str(loss) else []
+
+    variables = adapter.init(None, None)
+    return CompiledModel(
+        adapter,
+        params=variables["params"],
+        optimizer=optimizer,
+        loss=loss,
+        metrics=list(metrics),
+        batch_stats=variables.get("batch_stats", {}),
+    )

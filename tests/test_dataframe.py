@@ -71,3 +71,10 @@ def test_mllib_matrix_roundtrip():
     np.testing.assert_array_equal(mllib.from_matrix(mat), m)
     with pytest.raises(ValueError):
         mllib.to_matrix(np.ones(3))
+
+
+def test_out_of_range_labels_raise():
+    df = DataFrame({"features": np.zeros((3, 2), np.float32),
+                    "label": np.array([0.0, 1.0, 5.0])})
+    with pytest.raises(ValueError, match="labels outside"):
+        from_data_frame(df, categorical=True, nb_classes=3)

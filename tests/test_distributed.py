@@ -1,0 +1,30 @@
+"""Multi-host coordination helpers (single-host degradation paths)."""
+
+import os
+
+from elephas_tpu.parallel import distributed
+
+
+def test_single_host_noop_initialize():
+    distributed.initialize()  # must not raise or call jax.distributed
+
+
+def test_topology_helpers(devices):
+    assert distributed.is_host0()
+    assert distributed.host_count() == 1
+    assert distributed.total_chips() == 8
+    assert distributed.local_chips() == 8
+
+
+def test_parameter_server_address(monkeypatch):
+    monkeypatch.delenv("ELEPHAS_PS_ADDRESS", raising=False)
+    addr = distributed.parameter_server_address(4321)
+    assert addr.endswith(":4321")
+    monkeypatch.setenv("ELEPHAS_PS_ADDRESS", "10.0.0.5")
+    assert distributed.parameter_server_address(4321) == "10.0.0.5:4321"
+    monkeypatch.setenv("ELEPHAS_PS_ADDRESS", "10.0.0.5:9999")
+    assert distributed.parameter_server_address(4321) == "10.0.0.5:9999"
+
+
+def test_sync_global_single_host():
+    distributed.sync_global()  # no-op, must not raise

@@ -1,0 +1,72 @@
+"""Keras-3 (JAX backend) ingestion tests (SURVEY.md §7 hard part 2)."""
+
+import os
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+if keras.backend.backend() != "jax":  # keras already imported with another backend
+    pytest.skip("keras backend is not jax in this process", allow_module_level=True)
+
+from elephas_tpu import SparkModel, to_simple_rdd
+from elephas_tpu.serialize.keras_bridge import KerasModuleAdapter, from_keras
+
+from conftest import make_blobs
+
+
+def _keras_mlp(compile_it=True):
+    model = keras.Sequential(
+        [
+            keras.layers.Input((12,)),
+            keras.layers.Dense(24, activation="relu"),
+            keras.layers.Dropout(0.1),
+            keras.layers.Dense(3),
+        ]
+    )
+    if compile_it:
+        model.compile(optimizer=keras.optimizers.Adam(0.01), loss="categorical_crossentropy")
+    return model
+
+
+def test_from_keras_reads_compile_config():
+    compiled = from_keras(_keras_mlp())
+    assert compiled.loss_name == "categorical_crossentropy"
+    assert compiled.optimizer_config["name"] == "adam"
+    assert compiled.optimizer_config["learning_rate"] == pytest.approx(0.01)
+    assert compiled.count_params() == 12 * 24 + 24 + 24 * 3 + 3
+
+
+def test_from_keras_uncompiled_requires_explicit_args():
+    model = _keras_mlp(compile_it=False)
+    with pytest.raises(ValueError, match="not compiled"):
+        from_keras(model)
+    compiled = from_keras(model, optimizer="sgd", loss="categorical_crossentropy")
+    assert compiled.optimizer_config["name"] == "sgd"
+
+
+def test_keras_model_trains_through_spark_model():
+    x, y = make_blobs(n=384, num_classes=3, dim=12, seed=9)
+    compiled = from_keras(_keras_mlp())
+    model = SparkModel(compiled, mode="synchronous", frequency="batch", num_workers=4)
+    history = model.fit(to_simple_rdd(None, x, y, 4), epochs=3, batch_size=16)
+    assert history["acc"][-1] > 0.8
+    assert model.evaluate(x, y)["acc"] > 0.8
+    preds = model.predict(x[:5])
+    assert preds.shape == (5, 3)
+
+
+def test_keras_model_async_mode():
+    x, y = make_blobs(n=256, num_classes=3, dim=12, seed=10)
+    compiled = from_keras(_keras_mlp())
+    model = SparkModel(compiled, mode="hogwild", frequency="epoch", num_workers=2)
+    model.fit(to_simple_rdd(None, x, y, 2), epochs=3, batch_size=16)
+    assert model.evaluate(x, y)["acc"] > 0.8
+
+
+def test_adapter_rejects_unbuilt_model():
+    model = keras.Sequential([keras.layers.Dense(4)])
+    with pytest.raises(ValueError, match="build"):
+        KerasModuleAdapter(model)

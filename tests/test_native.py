@@ -1,0 +1,80 @@
+"""Native host-ops tests: correctness vs numpy, fallback behavior."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu import native
+
+
+def test_library_builds():
+    assert native.available(), "g++ toolchain present but native build failed"
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1000, 17)).astype(np.float32)
+    y = rng.integers(0, 5, size=(1000, 3)).astype(np.int32)
+    perm = rng.permutation(1000)
+    gx, gy = native.gather_rows(x, y, perm)
+    np.testing.assert_array_equal(gx, x[perm])
+    np.testing.assert_array_equal(gy, y[perm])
+
+
+def test_gather_rows_threaded_large():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(20000, 32)).astype(np.float32)
+    perm = rng.permutation(20000)
+    gx, gy = native.gather_rows(x, None, perm, n_threads=4)
+    assert gy is None
+    np.testing.assert_array_equal(gx, x[perm])
+
+
+def test_gather_rows_subset_and_dtypes():
+    """perm may select a subset; non-f32 dtypes ride the byte path."""
+    x = np.arange(40, dtype=np.float64).reshape(10, 4)
+    y = np.arange(10, dtype=np.int64)
+    perm = np.array([7, 1, 3])
+    gx, gy = native.gather_rows(x, y, perm)
+    np.testing.assert_array_equal(gx, x[perm])
+    np.testing.assert_array_equal(gy, y[perm])
+
+
+def test_encode_onehot_matches_reference():
+    labels = np.array([0, 2, 1, 3, 2])
+    out = native.encode_onehot(labels, 4)
+    np.testing.assert_array_equal(out, np.eye(4, dtype=np.float32)[labels])
+    # out-of-range labels produce all-zero rows, not corruption
+    weird = native.encode_onehot(np.array([0, 9, -1]), 3)
+    np.testing.assert_array_equal(weird[1], 0)
+    np.testing.assert_array_equal(weird[2], 0)
+
+
+def test_numpy_fallback_paths(monkeypatch):
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    perm = np.array([2, 0])
+    gx, gy = native.gather_rows(x, None, perm)
+    np.testing.assert_array_equal(gx, x[perm])
+    out = native.encode_onehot(np.array([1, 0]), 2)
+    np.testing.assert_array_equal(out, [[0, 1], [1, 0]])
+
+
+def test_corrupt_so_falls_back(tmp_path, monkeypatch):
+    """A corrupt .so must degrade to numpy, not crash training."""
+    import importlib
+    import elephas_tpu.native as native_mod
+
+    fake_so = tmp_path / "_host_ops.so"
+    fake_so.write_bytes(b"not a shared object")
+    src = tmp_path / "host_ops.cpp"
+    src.write_text("// stale source older than the so")
+    os_mod = __import__("os")
+    os_mod.utime(str(src), (0, 0))  # .so newer than source -> no rebuild
+    monkeypatch.setattr(native_mod, "_LIB_PATH", str(fake_so))
+    monkeypatch.setattr(native_mod, "_SRC", str(src))
+    monkeypatch.setattr(native_mod, "_lib", None)
+    monkeypatch.setattr(native_mod, "_build_failed", False)
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    gx, _ = native_mod.gather_rows(x, None, np.array([2, 1, 0]))
+    np.testing.assert_array_equal(gx, x[::-1])
+    assert native_mod._build_failed  # marked, so no retry storm
