@@ -199,6 +199,13 @@ class TpuModel:
             )
             self._sync_trainer = None
 
+        # Checkpoint saves run async during training; barrier before fit
+        # returns so snapshots are durable when the caller sees the result.
+        for cb in callbacks:
+            hook = getattr(cb, "on_fit_end", None)
+            if hook is not None:
+                hook()
+
         # Fold the trained weights back into the master network
         # (reference: master_network.set_weights after collect/PS stop).
         self._state = state

@@ -26,12 +26,21 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
         )
 
-    def save(self, state: TrainState, step: Optional[int] = None) -> None:
+    def save(self, state: TrainState, step: Optional[int] = None, block: bool = False) -> None:
+        """Snapshot ``state``. Async by default — Orbax copies device
+        buffers and persists in the background so training never stalls on
+        disk; call ``wait()`` (or pass ``block=True``) to barrier."""
         step = int(state.step) if step is None else int(step)
         self._manager.save(step, args=ocp.args.StandardSave(state))
+        if block:
+            self._manager.wait_until_finished()
+
+    def wait(self) -> None:
+        """Barrier on all in-flight saves (call at fit end)."""
         self._manager.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
+        self._manager.wait_until_finished()
         return self._manager.latest_step()
 
     def restore(self, target: TrainState, step: Optional[int] = None) -> TrainState:
@@ -41,16 +50,29 @@ class CheckpointManager:
         return self._manager.restore(step, args=ocp.args.StandardRestore(target))
 
     def callback(self):
-        """An ``(epoch, state, metrics)`` callback for trainer ``fit``."""
+        """An ``(epoch, state, metrics)`` callback for trainer ``fit``.
 
-        def cb(epoch: int, state: TrainState, metrics: dict) -> None:
-            if (epoch + 1) % self.save_every == 0:
-                self.save(state)
-
-        return cb
+        Saves are asynchronous on the training path; ``SparkModel.fit``
+        barriers via the callback's ``on_fit_end`` hook when training
+        completes (standalone trainer users call ``wait()`` themselves).
+        """
+        return _CheckpointCallback(self)
 
     def close(self) -> None:
+        self._manager.wait_until_finished()
         self._manager.close()
+
+
+class _CheckpointCallback:
+    def __init__(self, manager: "CheckpointManager"):
+        self._manager = manager
+
+    def __call__(self, epoch: int, state: TrainState, metrics: dict) -> None:
+        if (epoch + 1) % self._manager.save_every == 0:
+            self._manager.save(state)
+
+    def on_fit_end(self) -> None:
+        self._manager.wait()
 
 
 def save_train_state(directory: str, state: TrainState, step: Optional[int] = None) -> None:

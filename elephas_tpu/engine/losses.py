@@ -33,6 +33,29 @@ def _binary_crossentropy(logits, targets):
     return losses.reshape(losses.shape[0], -1).mean(axis=-1)
 
 
+_EPS = 1e-7  # Keras' epsilon for clipping probabilities
+
+
+def _categorical_crossentropy_probs(probs, targets):
+    """One-hot targets, softmax *probabilities* in (a Keras model whose
+    final layer applies softmax, loss from_logits=False)."""
+    p = jnp.clip(probs, _EPS, 1.0)
+    return -(targets * jnp.log(p)).sum(axis=-1)
+
+
+def _sparse_categorical_crossentropy_probs(probs, targets):
+    p = jnp.clip(probs, _EPS, 1.0)
+    idx = targets.astype(jnp.int32)[..., None]
+    return -jnp.log(jnp.take_along_axis(p, idx, axis=-1))[..., 0]
+
+
+def _binary_crossentropy_probs(probs, targets):
+    """Sigmoid *probabilities* in; targets in {0,1}."""
+    p = jnp.clip(probs, _EPS, 1.0 - _EPS)
+    losses = -(targets * jnp.log(p) + (1.0 - targets) * jnp.log1p(-p))
+    return losses.reshape(losses.shape[0], -1).mean(axis=-1)
+
+
 def _mse(preds, targets):
     err = jnp.square(preds - targets)
     return err.reshape(err.shape[0], -1).mean(axis=-1)
@@ -47,6 +70,9 @@ LOSSES: Dict[str, Callable] = {
     "categorical_crossentropy": _categorical_crossentropy,
     "sparse_categorical_crossentropy": _sparse_categorical_crossentropy,
     "binary_crossentropy": _binary_crossentropy,
+    "categorical_crossentropy_probs": _categorical_crossentropy_probs,
+    "sparse_categorical_crossentropy_probs": _sparse_categorical_crossentropy_probs,
+    "binary_crossentropy_probs": _binary_crossentropy_probs,
     "mse": _mse,
     "mean_squared_error": _mse,
     "mae": _mae,
@@ -79,12 +105,19 @@ def _binary_accuracy(logits, targets):
     return acc.reshape(acc.shape[0], -1).mean(axis=-1)
 
 
+def _binary_accuracy_probs(probs, targets):
+    pred = (probs > 0.5).astype(jnp.float32)
+    acc = (pred == targets).astype(jnp.float32)
+    return acc.reshape(acc.shape[0], -1).mean(axis=-1)
+
+
 METRICS: Dict[str, Callable] = {
     "acc": _accuracy,
     "accuracy": _accuracy,
     "categorical_accuracy": _accuracy,
     "sparse_categorical_accuracy": _accuracy,
     "binary_accuracy": _binary_accuracy,
+    "binary_accuracy_probs": _binary_accuracy_probs,
     "mae": _mae,
     "mse": _mse,
 }

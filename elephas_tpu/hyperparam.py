@@ -140,7 +140,9 @@ class HyperParamModel:
         def worker(index: int, device) -> None:
             # Independent stream per worker — the reference's independent
             # Trials() semantics (§3.4 note).
-            rng = np.random.default_rng(seed * 10_007 + index)
+            # SeedSequence spawning: collision-free across (seed, worker)
+            # pairs, unlike arithmetic seed mixing.
+            rng = np.random.default_rng([seed, index])
             try:
                 with jax.default_device(device):
                     for trial in range(trials_for[index]):

@@ -13,6 +13,7 @@ protocol, no dependency.
 
 from __future__ import annotations
 
+import os
 import pickle
 import socketserver
 import threading
@@ -24,6 +25,14 @@ import jax
 from elephas_tpu.parameter.base import BaseParameterServer
 from elephas_tpu.parameter.buffer import ParameterBuffer
 from elephas_tpu.utils import sockets as socket_utils
+
+
+def _default_bind_host() -> str:
+    """Loopback by default: the wire servers run unauthenticated pickle, so
+    exposure beyond the host must be an explicit opt-in — ``host='0.0.0.0'``
+    (passed by the async engine when a run is actually multi-host) or
+    ``ELEPHAS_PS_BIND`` in the environment."""
+    return os.environ.get("ELEPHAS_PS_BIND", "127.0.0.1")
 
 
 class LocalServer(BaseParameterServer):
@@ -64,10 +73,10 @@ class HttpServer(BaseParameterServer):
         lock: bool = True,
         port: int = 4000,
         device: Optional[jax.Device] = None,
-        host: str = "0.0.0.0",
+        host: Optional[str] = None,
     ):
         self.buffer = ParameterBuffer(params, lock=lock, device=device)
-        self.host = host
+        self.host = host if host is not None else _default_bind_host()
         self.port = port
         self._httpd = None
         self._thread = None
@@ -160,10 +169,10 @@ class SocketServer(BaseParameterServer):
         lock: bool = True,
         port: int = 4000,
         device: Optional[jax.Device] = None,
-        host: str = "0.0.0.0",
+        host: Optional[str] = None,
     ):
         self.buffer = ParameterBuffer(params, lock=lock, device=device)
-        self.host = host
+        self.host = host if host is not None else _default_bind_host()
         self.port = port
         self._server = None
         self._thread = None
@@ -197,12 +206,13 @@ def make_server(
     lock: bool = True,
     port: int = 4000,
     device: Optional[jax.Device] = None,
+    host: Optional[str] = None,
 ) -> BaseParameterServer:
     """Factory keyed on the reference's ``parameter_server_mode``."""
     if mode == "local":
         return LocalServer(params, lock=lock, device=device)
     if mode == "http":
-        return HttpServer(params, lock=lock, port=port, device=device)
+        return HttpServer(params, lock=lock, port=port, device=device, host=host)
     if mode == "socket":
-        return SocketServer(params, lock=lock, port=port, device=device)
+        return SocketServer(params, lock=lock, port=port, device=device, host=host)
     raise ValueError(f"parameter_server_mode must be local|http|socket, got {mode!r}")

@@ -122,11 +122,67 @@ def _optimizer_from_keras(keras_opt) -> dict:
     return {"name": name, "learning_rate": lr}
 
 
-def _loss_from_keras(keras_loss) -> str:
+def _final_activation_name(keras_model) -> str:
+    """Best-effort name of the output layer's activation ('linear' when
+    none / undeterminable). Handles both fused activations
+    (``Dense(n, activation=...)``) and standalone activation layers
+    (``keras.layers.Softmax()``, ``Activation('sigmoid')``)."""
+    try:
+        layer = keras_model.layers[-1]
+        cls = type(layer).__name__
+        if cls == "Softmax":
+            return "softmax"
+        if cls in ("Sigmoid",):
+            return "sigmoid"
+        act = getattr(layer, "activation", None)
+        return getattr(act, "__name__", "linear") if act is not None else "linear"
+    except Exception:
+        return "linear"
+
+
+def _loss_from_keras(keras_loss, keras_model) -> str:
+    """Map a Keras loss to an engine loss, honoring ``from_logits``.
+
+    Keras losses default ``from_logits=False`` and are typically paired
+    with a softmax/sigmoid output layer; the engine's plain crossentropy
+    losses expect *logits*. Mapping a probability-output model onto a
+    logit loss would apply softmax twice (silently wrong gradients), so:
+
+    - ``from_logits=True``            -> logit loss (plain name)
+    - probability output (softmax /
+      sigmoid final activation)       -> ``*_probs`` loss variant
+    - linear output, from_logits=False -> logit loss (the model emits
+      logits; this is the common "forgot from_logits" Keras setup and the
+      logit loss is the numerically sound interpretation)
+    - mismatched pairs (e.g. softmax output + binary loss) -> error
+    """
     key = keras_loss if isinstance(keras_loss, str) else type(keras_loss).__name__
-    if key in _KERAS_LOSS_NAMES:
-        return _KERAS_LOSS_NAMES[key]
-    raise ValueError(f"unmapped Keras loss {key!r}; pass loss=... explicitly")
+    if key not in _KERAS_LOSS_NAMES:
+        raise ValueError(f"unmapped Keras loss {key!r}; pass loss=... explicitly")
+    name = _KERAS_LOSS_NAMES[key]
+    if name not in ("categorical_crossentropy", "sparse_categorical_crossentropy",
+                    "binary_crossentropy"):
+        return name  # regression losses: logits/probs distinction is moot
+
+    from_logits = bool(getattr(keras_loss, "from_logits", False))
+    if from_logits:
+        return name
+    activation = _final_activation_name(keras_model)
+    if activation == "linear":
+        return name
+    if activation == "softmax" and name in (
+        "categorical_crossentropy", "sparse_categorical_crossentropy"
+    ):
+        return name + "_probs"
+    if activation == "sigmoid" and name == "binary_crossentropy":
+        return name + "_probs"
+    raise ValueError(
+        f"cannot map Keras loss {key!r} (from_logits=False) with final "
+        f"activation {activation!r}: expected a logits output, softmax + "
+        "categorical crossentropy, or sigmoid + binary crossentropy. Pass "
+        "loss=... explicitly (use the '*_probs' losses for probability "
+        "outputs)."
+    )
 
 
 def from_keras(
@@ -153,9 +209,18 @@ def from_keras(
         keras_loss = getattr(keras_model, "loss", None)
         if keras_loss is None:
             raise ValueError("model is not compiled; pass loss=...")
-        loss = _loss_from_keras(keras_loss)
+        loss = _loss_from_keras(keras_loss, keras_model)
     if metrics is None:
-        metrics = ["acc"] if "crossentropy" in str(loss) else []
+        if str(loss).startswith("binary_crossentropy"):
+            metrics = [
+                "binary_accuracy_probs"
+                if str(loss).endswith("_probs")
+                else "binary_accuracy"
+            ]
+        elif "crossentropy" in str(loss):
+            metrics = ["acc"]
+        else:
+            metrics = []
 
     variables = adapter.init(None, None)
     return CompiledModel(

@@ -22,17 +22,40 @@ import struct
 _LEN = struct.Struct("!Q")
 
 
+def host_ip() -> str:
+    """This host's outward-facing IP.
+
+    UDP-connect trick: "connecting" a datagram socket to any external
+    address selects the routable local interface without sending a packet.
+    ``gethostbyname(gethostname())`` — the reference's approach — returns
+    127.0.1.1 on many Linux hosts (an /etc/hosts alias), which other hosts
+    can't dial; this avoids that failure mode and needs no actual network
+    reachability.
+    """
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))  # never sent; routing only
+            ip = s.getsockname()[0]
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    try:  # fall back to resolver, rejecting loopback aliases
+        ip = socket.gethostbyname(socket.gethostname())
+        if not ip.startswith("127."):
+            return ip
+    except socket.gaierror:
+        pass
+    return "127.0.0.1"
+
+
 def determine_master(port: int = 4000) -> str:
     """Return ``"<host_ip>:<port>"`` for the driver/host-0 endpoint.
 
     Mirrors the reference's ``determine_master``; used to embed the
     parameter-server address into worker closures.
     """
-    try:
-        ip = socket.gethostbyname(socket.gethostname())
-    except socket.gaierror:
-        ip = "127.0.0.1"
-    return f"{ip}:{port}"
+    return f"{host_ip()}:{port}"
 
 
 def send(sock: socket.socket, obj) -> None:

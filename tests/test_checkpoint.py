@@ -92,3 +92,28 @@ def test_fit_callback_checkpoints_and_resume(tmp_path):
     restored_leaf = jax.tree_util.tree_leaves(restored.params)[0]
     np.testing.assert_allclose(np.asarray(trained_leaf), np.asarray(restored_leaf), rtol=1e-6)
     mgr.close()
+
+
+def test_async_checkpoints_advance_steps(tmp_path, blobs):
+    # Orbax silently no-ops on an already-saved step, so async epoch
+    # snapshots must carry an advancing step or only epoch 1 survives.
+    from elephas_tpu import SparkModel, to_simple_rdd
+
+    x, y = blobs
+    from elephas_tpu.api.compile import compile_model
+    from elephas_tpu.models import get_model
+
+    net = compile_model(
+        get_model("mlp", features=(16,), num_classes=4),
+        optimizer={"name": "sgd", "learning_rate": 0.05},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(x.shape[1],),
+    )
+    mgr = CheckpointManager(str(tmp_path), keep=5)
+    model = SparkModel(net, mode="asynchronous", frequency="epoch", num_workers=2)
+    model.fit(to_simple_rdd(None, x, y, 2), epochs=3, batch_size=16,
+              callbacks=[mgr.callback()])
+    steps = mgr._manager.all_steps()
+    assert sorted(steps) == [1, 2, 3], steps
+    mgr.close()

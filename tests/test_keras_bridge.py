@@ -70,3 +70,73 @@ def test_adapter_rejects_unbuilt_model():
     model = keras.Sequential([keras.layers.Dense(4)])
     with pytest.raises(ValueError, match="build"):
         KerasModuleAdapter(model)
+
+def test_softmax_output_maps_to_prob_loss_and_trains():
+    # Reference-style model: softmax output + from_logits=False loss must
+    # NOT be mapped onto the logit loss (double softmax) — ADVICE r1.
+    x, y = make_blobs(n=384, num_classes=3, dim=12, seed=11)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((12,)),
+            keras.layers.Dense(24, activation="relu"),
+            keras.layers.Dense(3, activation="softmax"),
+        ]
+    )
+    model.compile(optimizer=keras.optimizers.Adam(0.01), loss="categorical_crossentropy")
+    compiled = from_keras(model)
+    assert compiled.loss_name == "categorical_crossentropy_probs"
+    sm = SparkModel(compiled, mode="synchronous", frequency="batch", num_workers=4)
+    history = sm.fit(to_simple_rdd(None, x, y, 4), epochs=3, batch_size=16)
+    assert history["acc"][-1] > 0.8
+
+
+def test_sigmoid_binary_maps_to_prob_loss_and_metric():
+    model = keras.Sequential(
+        [
+            keras.layers.Input((8,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(1, activation="sigmoid"),
+        ]
+    )
+    model.compile(optimizer=keras.optimizers.Adam(0.02), loss="binary_crossentropy")
+    compiled = from_keras(model)
+    assert compiled.loss_name == "binary_crossentropy_probs"
+    assert "binary_accuracy_probs" in compiled.metric_names
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 1)).astype(np.float32)
+    y = (x @ w > 0).astype(np.float32)
+    sm = SparkModel(compiled, mode="synchronous", frequency="batch", num_workers=4)
+    history = sm.fit(to_simple_rdd(None, x, y, 4), epochs=10, batch_size=16)
+    assert history["binary_accuracy_probs"][-1] > 0.8
+
+
+def test_from_logits_true_keeps_logit_loss():
+    model = _keras_mlp(compile_it=False)
+    model.compile(
+        optimizer=keras.optimizers.Adam(0.01),
+        loss=keras.losses.CategoricalCrossentropy(from_logits=True),
+    )
+    compiled = from_keras(model)
+    assert compiled.loss_name == "categorical_crossentropy"
+
+
+def test_mismatched_activation_loss_pair_raises():
+    model = keras.Sequential(
+        [
+            keras.layers.Input((8,)),
+            keras.layers.Dense(3, activation="softmax"),
+        ]
+    )
+    model.compile(optimizer="adam", loss="binary_crossentropy")
+    with pytest.raises(ValueError, match="cannot map"):
+        from_keras(model)
+
+
+def test_standalone_softmax_layer_maps_to_prob_loss():
+    model = keras.Sequential(
+        [keras.layers.Input((6,)), keras.layers.Dense(3), keras.layers.Softmax()]
+    )
+    model.compile(optimizer="adam", loss="categorical_crossentropy")
+    compiled = from_keras(model)
+    assert compiled.loss_name == "categorical_crossentropy_probs"

@@ -83,3 +83,44 @@ def test_make_server_factory():
     assert isinstance(make_server("socket", _params(), port=0), SocketServer)
     with pytest.raises(ValueError):
         make_server("flask", _params())
+
+
+def test_wire_servers_bind_loopback_by_default():
+    # ADVICE r1: unauthenticated pickle transports must not listen on all
+    # interfaces unless explicitly asked to.
+    from elephas_tpu.parameter.server import HttpServer, SocketServer
+
+    params = {"params": {"w": np.zeros(2, np.float32)}, "batch_stats": {}}
+    for cls in (HttpServer, SocketServer):
+        srv = cls(params, port=0)
+        assert srv.host == "127.0.0.1"
+        srv2 = cls(params, port=0, host="0.0.0.0")
+        assert srv2.host == "0.0.0.0"
+
+
+def test_prob_losses_match_logit_losses():
+    import jax.numpy as jnp
+    from elephas_tpu.engine.losses import LOSSES
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(16, 5)).astype(np.float32))
+    onehot = jnp.asarray(np.eye(5, dtype=np.float32)[rng.integers(0, 5, 16)])
+    probs = jax.nn.softmax(logits, axis=-1)
+    np.testing.assert_allclose(
+        LOSSES["categorical_crossentropy_probs"](probs, onehot),
+        LOSSES["categorical_crossentropy"](logits, onehot),
+        rtol=1e-5, atol=1e-5,
+    )
+    labels = jnp.argmax(onehot, axis=-1)
+    np.testing.assert_allclose(
+        LOSSES["sparse_categorical_crossentropy_probs"](probs, labels),
+        LOSSES["sparse_categorical_crossentropy"](logits, labels),
+        rtol=1e-5, atol=1e-5,
+    )
+    blogits = jnp.asarray(rng.normal(size=(16, 1)).astype(np.float32))
+    btargets = jnp.asarray(rng.integers(0, 2, (16, 1)).astype(np.float32))
+    np.testing.assert_allclose(
+        LOSSES["binary_crossentropy_probs"](jax.nn.sigmoid(blogits), btargets),
+        LOSSES["binary_crossentropy"](blogits, btargets),
+        rtol=1e-4, atol=1e-5,
+    )
