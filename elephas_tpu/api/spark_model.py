@@ -147,8 +147,14 @@ class TpuModel:
         validation_split: float = 0.0,
         validation_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         callbacks=(),
+        stream_batches: Optional[int] = None,
     ) -> Dict[str, List[float]]:
-        """Train on a ShardedDataset (or ``(x, y)``), reference §3.1/§3.2."""
+        """Train on a ShardedDataset (or ``(x, y)``), reference §3.1/§3.2.
+
+        ``stream_batches`` (sync mode): cap HBM residency at ~2×N global
+        batches with a double-buffered host→device pipeline — for
+        datasets larger than device memory.
+        """
         batch_size = batch_size or self.batch_size
         dataset = self._as_dataset(rdd, batch_size)
         if dataset.labels is None:
@@ -176,9 +182,15 @@ class TpuModel:
                 validation_data=validation_data,
                 verbose=verbose,
                 callbacks=callbacks,
+                stream_batches=stream_batches,
             )
             self._sync_trainer = trainer
         else:
+            if stream_batches is not None:
+                raise ValueError(
+                    "stream_batches applies to mode='synchronous' (async "
+                    "workers already stream per-partition)"
+                )
             from elephas_tpu.engine.async_engine import AsyncTrainer
 
             trainer = AsyncTrainer(

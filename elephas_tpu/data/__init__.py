@@ -15,3 +15,4 @@ from elephas_tpu.data.rdd import (  # noqa: F401
     to_simple_rdd,
 )
 from elephas_tpu.data.dataframe import DataFrame  # noqa: F401
+from elephas_tpu.data import datasets  # noqa: F401

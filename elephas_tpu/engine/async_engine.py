@@ -58,10 +58,22 @@ class AsyncTrainer:
         self.lock = lock
         self.parameter_server_mode = parameter_server_mode
         self.port = port
-        # One worker per device along the data axis.
+        # One worker per device along the data axis. Under multi-host SPMD
+        # every process constructs the same global mesh but drives only its
+        # *addressable* devices; the partition index stays global so shard g
+        # of the dataset is trained by exactly one worker in the job
+        # (reference: one RDD partition per executor, SURVEY.md §3.2).
         n_data = mesh.shape[DATA_AXIS]
-        self.devices = list(np.asarray(mesh.devices).reshape(mesh.devices.shape[0], -1)[:, 0][:n_data])
-        self.n_workers = len(self.devices)
+        data_devices = list(
+            np.asarray(mesh.devices).reshape(mesh.devices.shape[0], -1)[:, 0][:n_data]
+        )
+        pid = jax.process_index()
+        self.workers = [
+            (g, dev) for g, dev in enumerate(data_devices) if dev.process_index == pid
+        ]
+        self.devices = [dev for _, dev in self.workers]
+        self.n_workers = len(self.workers)  # local worker count
+        self.n_global_workers = len(data_devices)
         self._train_step = make_train_step(compiled)
         self._subtract = jax.jit(subtract_params)
         self._epoch_fn = jax.jit(make_epoch_scanner(self._train_step))
@@ -83,14 +95,62 @@ class AsyncTrainer:
     ) -> Tuple[TrainState, Dict[str, List[float]]]:
         compiled = self.compiled
         store0 = {"params": compiled.params, "batch_stats": compiled.batch_stats}
-        server = make_server(
-            self.parameter_server_mode,
-            store0,
-            lock=self.lock,
-            port=self.port,
-            device=jax.devices()[0],
-        )
-        server.start()
+        multi_host = jax.process_count() > 1
+        if multi_host and self.parameter_server_mode == "local":
+            raise ValueError(
+                "multi-host async/hogwild needs parameter_server_mode='http' "
+                "or 'socket' — the in-process buffer spans one host"
+            )
+
+        # Reference topology (SURVEY.md §3.2): ONE parameter server on the
+        # driver (host 0); every worker on every host dials it. Host 0
+        # binds all interfaces (cross-host must be reachable), broadcasts
+        # its routable address over the DCN control plane, and the
+        # broadcast doubles as the "server is up" barrier.
+        server = None
+        remote_client_factory = None
+        if not multi_host:
+            server = make_server(
+                self.parameter_server_mode,
+                store0,
+                lock=self.lock,
+                port=self.port,
+                device=jax.local_devices()[0],
+            )
+            server.start()
+        else:
+            import os
+
+            from elephas_tpu.parallel import distributed
+            from elephas_tpu.parameter.client import make_client
+            from elephas_tpu.utils.sockets import determine_master
+
+            if distributed.is_host0():
+                server = make_server(
+                    self.parameter_server_mode,
+                    store0,
+                    lock=self.lock,
+                    port=self.port,
+                    device=jax.local_devices()[0],
+                    host=os.environ.get("ELEPHAS_PS_BIND", "0.0.0.0"),
+                )
+                server.start()
+            if server is not None:
+                # Advertise what peers can actually dial: a pinned bind
+                # interface verbatim; for wildcard binds, this host's
+                # routable IP.
+                if server.host not in ("0.0.0.0", "::", ""):
+                    advertised = f"{server.host}:{server.port}"
+                else:
+                    advertised = determine_master(server.port)
+            else:
+                advertised = ""
+            address = os.environ.get(
+                "ELEPHAS_PS_ADDRESS"
+            ) or distributed.broadcast_from_host0(advertised)
+            remote_client_factory = lambda: make_client(  # noqa: E731
+                self.parameter_server_mode, address
+            )
 
         per_worker_metrics: List[List[Dict[str, float]]] = [None] * self.n_workers
         errors: List[BaseException] = []
@@ -105,9 +165,14 @@ class AsyncTrainer:
         val_records: List[Optional[Dict[str, float]]] = [None] * epochs
         val_trainer = None
 
+        def pull_snapshot():
+            if server is not None:
+                return jax.device_get(server.get_parameters())
+            return remote_client_factory().get_parameters()
+
         def on_epoch_done(epoch: int) -> None:
             nonlocal epochs_fired, val_trainer
-            if not callbacks and validation_data is None:
+            if not callbacks and (validation_data is None or multi_host):
                 return
             fire = None
             with barrier_lock:
@@ -119,7 +184,7 @@ class AsyncTrainer:
                     fire = epoch
                     epochs_fired += 1
             if fire is not None:
-                snapshot = jax.device_get(server.get_parameters())
+                snapshot = pull_snapshot()
                 # step must advance per epoch or rotating checkpointers
                 # (keyed on state.step) silently drop every save after the
                 # first — Orbax no-ops on an already-saved step.
@@ -129,7 +194,11 @@ class AsyncTrainer:
                     batch_stats=snapshot["batch_stats"],
                     step=fire + 1,
                 )
-                if validation_data is not None:
+                if validation_data is not None and not multi_host:
+                    # Multi-host: the epoch barrier here is *local*; a
+                    # global-mesh SPMD evaluate from unsynchronized barrier
+                    # threads would desync collectives, so validation runs
+                    # on the final state after fit instead.
                     if val_trainer is None:
                         from elephas_tpu.engine.sync import SyncTrainer
 
@@ -142,28 +211,60 @@ class AsyncTrainer:
                 for cb in callbacks:
                     cb(fire, snap_state, {})
 
-        def worker(index: int, device: jax.Device) -> None:
+        def worker(slot: int, global_index: int, device: jax.Device) -> None:
             try:
-                per_worker_metrics[index] = self._run_worker(
-                    index, device, server, dataset, epochs, batch_size,
+                client = (
+                    server.client()
+                    if server is not None
+                    else remote_client_factory()
+                )
+                per_worker_metrics[slot] = self._run_worker(
+                    global_index, device, client, dataset, epochs, batch_size,
                     on_epoch_done=on_epoch_done,
                 )
             except BaseException as exc:  # surfaced after join
                 errors.append(exc)
 
         threads = [
-            threading.Thread(target=worker, args=(i, dev), daemon=True)
-            for i, dev in enumerate(self.devices)
+            threading.Thread(target=worker, args=(slot, g, dev), daemon=True)
+            for slot, (g, dev) in enumerate(self.workers)
         ]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
 
-        final = jax.device_get(server.get_parameters())
-        server.stop()
         if errors:
+            # Multi-host: raising here (instead of entering the global
+            # barrier) fails this process fast; peers' barriers abort via
+            # the launcher's job-level restart (SURVEY.md §5.3 delegation).
+            if server is not None:
+                server.stop()
             raise errors[0]
+
+        if multi_host:
+            # PS-backed host barriers (not device collectives): async hosts
+            # can drift by minutes, far past collective-rendezvous deadlines.
+            n_hosts = jax.process_count()
+            ctl = server.client() if server is not None else remote_client_factory()
+            ctl.wait_barrier("elephas:pushes_done", n_hosts)
+            final = pull_snapshot()
+            if server is not None:
+                # Host 0 keeps the PS alive until every peer has announced
+                # its final read, then tears it down.
+                ctl.wait_barrier("elephas:final_read", n_hosts)
+            else:
+                # Peers only announce — waiting here would race the
+                # server shutdown (host 0 stops the PS once the count
+                # completes, possibly mid-poll).
+                ctl.barrier_arrive("elephas:final_read")
+            if hasattr(ctl, "close"):
+                ctl.close()
+            if server is not None:
+                server.stop()
+        else:
+            final = jax.device_get(server.get_parameters())
+            server.stop()
 
         # Master state from the server's final weights; metrics averaged
         # across workers per epoch.
@@ -201,14 +302,16 @@ class AsyncTrainer:
         self,
         index: int,
         device: jax.Device,
-        server,
+        client,
         dataset,
         epochs: int,
         batch_size: int,
         on_epoch_done=None,
     ) -> List[Dict[str, float]]:
+        """``index`` is the worker's GLOBAL slot along the data axis —
+        it selects the dataset partition and seeds the RNG streams, so
+        each shard is trained by exactly one worker job-wide."""
         compiled = self.compiled
-        client = server.client()
         x, y = dataset.partition(index)
         nb = len(x) // batch_size
         if nb == 0:

@@ -72,8 +72,12 @@ def _blockwise_reference(q, k, v, causal: bool, block_q: int, block_k: int):
     return out[:, :, :sq]
 
 
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
 def _forward_impl(q, k, v, causal, block_q, block_k):
-    if jax.default_backend() == "tpu":
+    if _on_tpu():
         from elephas_tpu.ops.attention_pallas import pallas_flash_attention
 
         return pallas_flash_attention(
@@ -88,12 +92,28 @@ def _flash(q, k, v, causal, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    return _forward_impl(q, k, v, causal, block_q, block_k), (q, k, v)
+    if _on_tpu():
+        from elephas_tpu.ops.attention_pallas import pallas_flash_attention
+
+        # Save (o, lse) so the backward recomputes attention weights from
+        # the streamed tiles — fused Pallas dq/dk/dv, no score matrix.
+        o, lse = pallas_flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+            return_lse=True,
+        )
+        return o, (q, k, v, o, lse)
+    return _blockwise_reference(q, k, v, causal, block_q, block_k), (q, k, v)
 
 
 def _flash_bwd(causal, block_q, block_k, residuals, g):
-    # Backward via the XLA blockwise path (same numerics as the kernel);
-    # XLA fuses it well enough for training, and it runs on every backend.
+    if len(residuals) == 5:  # TPU: fused Pallas backward kernels
+        from elephas_tpu.ops.attention_pallas import pallas_flash_attention_bwd
+
+        q, k, v, o, lse = residuals
+        return pallas_flash_attention_bwd(
+            q, k, v, o, lse, g, causal=causal, block_q=block_q, block_k=block_k
+        )
+    # Other backends: backward via the XLA blockwise path (same numerics).
     q, k, v = residuals
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _blockwise_reference(q_, k_, v_, causal, block_q, block_k),

@@ -10,9 +10,12 @@ live in VMEM scratch, which persists across the innermost (k) grid steps
 of a given q tile. Matmuls hit the MXU with f32 accumulation; causal
 tiles above the diagonal are skipped via ``pl.when`` (no FLOPs).
 
-Backward pass: the public ``flash_attention`` wrapper (ops/attention.py)
-wires this forward into a ``jax.custom_vjp`` whose backward re-computes
-via the XLA blockwise implementation.
+Backward pass: fused Pallas kernels (``pallas_flash_attention_bwd``) that
+recompute attention weights from the saved (q, k, lse) residuals in the
+same streamed-tile structure — dq accumulates over k tiles, dk/dv over q
+tiles. The public ``flash_attention`` wrapper (ops/attention.py) wires
+forward+backward into a ``jax.custom_vjp``; non-TPU backends fall back to
+an XLA blockwise VJP.
 
 Follows /opt/skills/guides/pallas_guide.md (grid/BlockSpec pipelining,
 scratch accumulators, 2-D iota, preferred_element_type on MXU matmuls).
@@ -35,6 +38,7 @@ def _flash_fwd_kernel(
     k_ref,
     v_ref,
     o_ref,
+    lse_ref,
     acc_ref,
     m_ref,
     l_ref,
@@ -104,26 +108,64 @@ def _flash_fwd_kernel(
         o_ref[0, ...] = (
             acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
         ).astype(o_ref.dtype)
+        # Row log-sum-exp (the backward residual). Fully-masked (padded)
+        # rows get a large-negative finite value, so exp(-inf - lse) == 0
+        # in the backward kernels instead of NaN.
+        m = m_ref[...]
+        shift = jnp.where(jnp.isfinite(m), m, 0.0)
+        lse = (shift + jnp.log(jnp.maximum(l_ref[...], 1e-30)))[:, 0]
+        lse_ref[0, ...] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
-def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512):
-    """q, k, v: (batch, heads, seq, head_dim) -> same-shaped output."""
-    batch, heads, seq_len, head_dim = q.shape
-    sm_scale = 1.0 / (head_dim**0.5)
-
+def _sanitize_blocks(seq_len: int, block_q: int, block_k: int):
+    """Clamp to the sequence, and keep multi-block tile sizes on the
+    TPU-mappable grid (multiples of 128 on the minor-most score dim)."""
     block_q = min(block_q, max(seq_len, 8))
     block_k = min(block_k, max(seq_len, 8))
+    if block_q < seq_len:
+        block_q = max(128, (block_q // 128) * 128)
+    if block_k < seq_len:
+        block_k = max(128, (block_k // 128) * 128)
+    return block_q, block_k
+
+
+def _pad_reshape(q, k, v, block_q, block_k):
+    batch, heads, seq_len, head_dim = q.shape
     pad_q = (-seq_len) % block_q
     pad_k = (-seq_len) % block_k
     qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
     kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
     vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
-
     bh = batch * heads
     qp = qp.reshape(bh, qp.shape[2], head_dim)
     kp = kp.reshape(bh, kp.shape[2], head_dim)
     vp = vp.reshape(bh, vp.shape[2], head_dim)
+    return qp, kp, vp
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "return_lse")
+)
+def pallas_flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
+    return_lse: bool = False,
+):
+    """q, k, v: (batch, heads, seq, head_dim) -> same-shaped output.
+
+    ``return_lse=True`` additionally returns the per-row log-sum-exp
+    ``(batch, heads, seq)`` — the residual the Pallas backward needs.
+    """
+    batch, heads, seq_len, head_dim = q.shape
+    sm_scale = 1.0 / (head_dim**0.5)
+
+    block_q, block_k = _sanitize_blocks(seq_len, block_q, block_k)
+    qp, kp, vp = _pad_reshape(q, k, v, block_q, block_k)
+    bh = batch * heads
     num_q = qp.shape[1] // block_q
     num_k = kp.shape[1] // block_k
 
@@ -135,7 +177,7 @@ def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 512, blo
         causal=causal,
         sm_scale=sm_scale,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, num_q, num_k),
         in_specs=[
@@ -155,12 +197,25 @@ def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 512, blo
                 memory_space=pltpu.VMEM,
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, head_dim),
-            lambda b, i, j: (b, i, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((bh, qp.shape[1], head_dim), q.dtype),
+        out_specs=[
+            pl.BlockSpec(
+                (1, block_q, head_dim),
+                lambda b, i, j: (b, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 8, block_q),
+                lambda b, i, j: (b, 0, i),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, qp.shape[1], head_dim), q.dtype),
+            # lse replicated across 8 sublanes: TPU block tiling wants the
+            # second-minor block dim divisible by 8, so a plain (1, block_q)
+            # row block is unmappable; the 8x copy is negligible (f32 rows).
+            jax.ShapeDtypeStruct((bh, 8, qp.shape[1]), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, head_dim), jnp.float32),  # acc
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max
@@ -172,5 +227,240 @@ def pallas_flash_attention(q, k, v, causal: bool = True, block_q: int = 512, blo
             transcendentals=int(bh * seq_len * seq_len),
         ),
     )(qp, kp, vp)
-    out = out.reshape(batch, heads, -1, head_dim)
-    return out[:, :, :seq_len]
+    out = out.reshape(batch, heads, -1, head_dim)[:, :, :seq_len]
+    if return_lse:
+        return out, lse[:, 0, :].reshape(batch, heads, -1)[:, :, :seq_len]
+    return out
+
+
+# --------------------------------------------------------------- backward
+
+
+def _flash_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc_ref,
+    *, block_q, block_k, seq_len, causal, sm_scale,
+):
+    """Program (b, qi, kj): fold K/V tile kj into q tile qi's dq.
+
+    dq_i = sm_scale * sum_j p_ij (dO_i.V_j - D_i) k_j, with
+    p_ij = exp(sm_scale q_i.k_j - lse_i) and D = rowsum(dO * O)
+    (precomputed, streamed in as `delta`). Same streamed-K/V structure as
+    the forward: VMEM holds one K/V tile + the (block_q, d) accumulator.
+    """
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    needed = jnp.logical_or(not causal, kj * block_k <= (qi + 1) * block_q - 1)
+
+    @pl.when(needed)
+    def _fold():
+        q = q_ref[0, ...].astype(jnp.float32)
+        k_tile = k_ref[0, ...].astype(jnp.float32)
+        v_tile = v_ref[0, ...].astype(jnp.float32)
+        do = do_ref[0, ...].astype(jnp.float32)
+        lse = lse_ref[0, 0, :].astype(jnp.float32)  # (block_q,)
+        delta = delta_ref[0, 0, :].astype(jnp.float32)  # (block_q,)
+
+        scores = sm_scale * jax.lax.dot_general(
+            q, k_tile, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = k_pos < seq_len
+        if causal:
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        p = jnp.where(valid, jnp.exp(scores - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_tile, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dq_acc_ref[...] += jax.lax.dot_general(
+            ds, k_tile, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        dq_ref[0, ...] = (sm_scale * dq_acc_ref[...]).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, block_q, block_k, seq_len, causal, sm_scale,
+):
+    """Program (b, kj, qi): fold Q/dO tile qi into k tile kj's dk/dv.
+
+    dv_j = sum_i p_ij dO_i ; dk_j = sm_scale * sum_i p_ij (dO_i.V_j - D_i) q_i.
+    Streams Q/dO tiles through VMEM with (block_k, d) accumulators.
+    """
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    # Causal: q tile qi contributes to k tile kj iff its last q pos >= first k pos.
+    needed = jnp.logical_or(not causal, (qi + 1) * block_q - 1 >= kj * block_k)
+
+    @pl.when(needed)
+    def _fold():
+        q = q_ref[0, ...].astype(jnp.float32)
+        k_tile = k_ref[0, ...].astype(jnp.float32)
+        v_tile = v_ref[0, ...].astype(jnp.float32)
+        do = do_ref[0, ...].astype(jnp.float32)
+        lse = lse_ref[0, 0, :].astype(jnp.float32)  # (block_q,)
+        delta = delta_ref[0, 0, :].astype(jnp.float32)
+
+        # (block_k, block_q): transposed scores, k-major for the accumulators.
+        scores_t = sm_scale * jax.lax.dot_general(
+            k_tile, q, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0
+        )
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1
+        )
+        valid = jnp.logical_and(k_pos < seq_len, q_pos < seq_len)
+        if causal:
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        p_t = jnp.where(valid, jnp.exp(scores_t - lse[None, :]), 0.0)
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p_t, do, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp_t = jax.lax.dot_general(
+            v_tile, do, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, block_q)
+        ds_t = p_t * (dp_t - delta[None, :])
+        dk_acc_ref[...] += jax.lax.dot_general(
+            ds_t, q, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0, ...] = (sm_scale * dk_acc_ref[...]).astype(dk_ref.dtype)
+        dv_ref[0, ...] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def pallas_flash_attention_bwd(
+    q, k, v, o, lse, do, causal: bool = True, block_q: int = 512, block_k: int = 512
+):
+    """Fused dq/dk/dv with forward recompute of the attention weights from
+    (q, k, lse) — the score matrix never materializes in HBM, matching the
+    forward's streamed-tile memory profile. Two kernels: dq accumulates
+    over k tiles; dk/dv accumulate over q tiles.
+    """
+    batch, heads, seq_len, head_dim = q.shape
+    sm_scale = 1.0 / (head_dim**0.5)
+    block_q, block_k = _sanitize_blocks(seq_len, block_q, block_k)
+
+    # D = rowsum(dO * O): tiny elementwise reduction; XLA fuses it.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    qp, kp, vp = _pad_reshape(q, k, v, block_q, block_k)
+    dop, _, _ = _pad_reshape(do, k, v, block_q, block_k)
+    bh = batch * heads
+    padded_q = qp.shape[1]
+    pad_rows = padded_q - seq_len
+    # 8-sublane replication: see the forward lse out_shape note.
+    lsep = jnp.broadcast_to(
+        jnp.pad(
+            lse.reshape(bh, seq_len).astype(jnp.float32), ((0, 0), (0, pad_rows))
+        )[:, None, :],
+        (bh, 8, padded_q),
+    )
+    deltap = jnp.broadcast_to(
+        jnp.pad(
+            delta.reshape(bh, seq_len).astype(jnp.float32), ((0, 0), (0, pad_rows))
+        )[:, None, :],
+        (bh, 8, padded_q),
+    )
+    num_q = padded_q // block_q
+    num_k = kp.shape[1] // block_k
+
+    q_spec = pl.BlockSpec(
+        (1, block_q, head_dim), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
+    )
+    row_spec = pl.BlockSpec(
+        (1, 8, block_q), lambda b, i, j: (b, 0, i), memory_space=pltpu.VMEM
+    )
+    k_spec = pl.BlockSpec(
+        (1, block_k, head_dim), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel,
+            block_q=block_q, block_k=block_k, seq_len=seq_len,
+            causal=causal, sm_scale=sm_scale,
+        ),
+        grid=(bh, num_q, num_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, padded_q, head_dim), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=int(6 * bh * seq_len * seq_len * head_dim * (0.5 if causal else 1.0)),
+            bytes_accessed=int(5 * bh * seq_len * head_dim * q.dtype.itemsize),
+            transcendentals=int(bh * seq_len * seq_len),
+        ),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    # dk/dv: swap the streaming axes — k tiles outer, q tiles inner.
+    kq_q_spec = pl.BlockSpec(
+        (1, block_q, head_dim), lambda b, j, i: (b, i, 0), memory_space=pltpu.VMEM
+    )
+    kq_row_spec = pl.BlockSpec(
+        (1, 8, block_q), lambda b, j, i: (b, 0, i), memory_space=pltpu.VMEM
+    )
+    kq_k_spec = pl.BlockSpec(
+        (1, block_k, head_dim), lambda b, j, i: (b, j, 0), memory_space=pltpu.VMEM
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel,
+            block_q=block_q, block_k=block_k, seq_len=seq_len,
+            causal=causal, sm_scale=sm_scale,
+        ),
+        grid=(bh, num_k, num_q),
+        in_specs=[kq_q_spec, kq_k_spec, kq_k_spec, kq_q_spec, kq_row_spec, kq_row_spec],
+        out_specs=[kq_k_spec, kq_k_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, kp.shape[1], head_dim), k.dtype),
+            jax.ShapeDtypeStruct((bh, vp.shape[1], head_dim), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=int(8 * bh * seq_len * seq_len * head_dim * (0.5 if causal else 1.0)),
+            bytes_accessed=int(5 * bh * seq_len * head_dim * q.dtype.itemsize),
+            transcendentals=int(bh * seq_len * seq_len),
+        ),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dq = dq.reshape(batch, heads, -1, head_dim)[:, :, :seq_len]
+    dk = dk.reshape(batch, heads, -1, head_dim)[:, :, :seq_len]
+    dv = dv.reshape(batch, heads, -1, head_dim)[:, :, :seq_len]
+    return dq, dk, dv

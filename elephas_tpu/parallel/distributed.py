@@ -76,19 +76,39 @@ def host_count() -> int:
     return jax.process_count()
 
 
+_ADDR_BYTES = 64  # fixed frame for the broadcast ("ip:port" padded)
+
+
+def broadcast_from_host0(value: str, max_bytes: int = _ADDR_BYTES) -> str:
+    """Broadcast a short string from host 0 to every host (DCN control
+    plane). No-op single-host. Uses a fixed-size uint8 frame so the
+    collective has a static shape on every process."""
+    if jax.process_count() == 1:
+        return value
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    frame = np.zeros(max_bytes, dtype=np.uint8)
+    if is_host0():
+        raw = value.encode()
+        if len(raw) > max_bytes:
+            raise ValueError(f"broadcast payload too long ({len(raw)} > {max_bytes})")
+        frame[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(frame))
+    return bytes(out[out != 0]).decode()
+
+
 def parameter_server_address(port: int = 4000) -> str:
     """Where async workers on any host reach the PS (host 0).
 
-    Single-host: loopback-reachable address from ``determine_master``.
-    Multi-host: host 0 publishes its address via the coordinator KV store
-    would be ideal; absent that API dependency, deployments set
-    ``ELEPHAS_PS_ADDRESS`` (e.g. from the pod manifest). Falls back to
-    this host's own address, correct only on host 0.
+    Resolution order: explicit ``ELEPHAS_PS_ADDRESS`` (e.g. from a pod
+    manifest), then — multi-host — host 0's routable IP broadcast over the
+    DCN control plane, else this host's own address (single-host).
     """
     explicit = os.environ.get("ELEPHAS_PS_ADDRESS")
     if explicit:
         return explicit if ":" in explicit else f"{explicit}:{port}"
-    return determine_master(port)
+    return broadcast_from_host0(determine_master(port))
 
 
 def sync_global(tag: int = 0) -> None:

@@ -11,6 +11,7 @@ from __future__ import annotations
 import pickle
 import socket
 import threading
+import time
 import urllib.request
 
 import jax
@@ -30,8 +31,39 @@ class LocalClient(BaseParameterClient):
     def update_parameters(self, delta) -> None:
         self._buffer.apply_delta(delta)
 
+    def wait_barrier(self, tag: str, n: int, timeout: float = 600.0) -> None:
+        pass  # in-process buffer == single host; nothing to synchronize
 
-class HttpClient(BaseParameterClient):
+
+class _WireBarrierMixin:
+    """PS-backed host barrier: arrive once, then poll the arrival count.
+
+    Used for fit teardown across hosts. Polling the PS (instead of a
+    device collective) tolerates arbitrary host drift — async workers can
+    be minutes apart, far past collective-rendezvous deadlines.
+    """
+
+    def barrier_arrive(self, tag: str) -> int:
+        raise NotImplementedError
+
+    def barrier_count(self, tag: str) -> int:
+        raise NotImplementedError
+
+    def wait_barrier(self, tag: str, n: int, timeout: float = 600.0) -> None:
+        self.barrier_arrive(tag)
+        deadline = time.monotonic() + timeout
+        poll = 0.02
+        while time.monotonic() < deadline:
+            if self.barrier_count(tag) >= n:
+                return
+            time.sleep(poll)
+            poll = min(poll * 2, 0.5)
+        raise TimeoutError(
+            f"barrier {tag!r}: {self.barrier_count(tag)}/{n} hosts after {timeout}s"
+        )
+
+
+class HttpClient(_WireBarrierMixin, BaseParameterClient):
     """urllib against ``GET /parameters`` / ``POST /update``."""
 
     def __init__(self, master_url: str, timeout: float = 60.0):
@@ -56,8 +88,35 @@ class HttpClient(BaseParameterClient):
         with urllib.request.urlopen(req, timeout=self.timeout):
             pass
 
+    def barrier_arrive(self, tag: str) -> int:
+        req = urllib.request.Request(
+            f"http://{self.master_url}/barrier/{tag}", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return int(resp.read())
 
-class SocketClient(BaseParameterClient):
+    def barrier_count(self, tag: str) -> int:
+        with urllib.request.urlopen(
+            f"http://{self.master_url}/barrier/{tag}", timeout=self.timeout
+        ) as resp:
+            return int(resp.read())
+
+
+def make_client(mode: str, address: str) -> BaseParameterClient:
+    """Client for a parameter server reachable at ``address`` ("ip:port").
+
+    The cross-host worker path: hosts that did not start the server dial
+    the address host 0 broadcast (reference topology — every worker talks
+    to the one driver PS, SURVEY.md §3.2).
+    """
+    if mode == "http":
+        return HttpClient(address)
+    if mode == "socket":
+        return SocketClient(address)
+    raise ValueError(f"no wire client for parameter_server_mode={mode!r}")
+
+
+class SocketClient(_WireBarrierMixin, BaseParameterClient):
     """Persistent framed-TCP connection (one per worker thread)."""
 
     def __init__(self, master_url: str):
@@ -83,6 +142,18 @@ class SocketClient(BaseParameterClient):
             sock = self._connection()
             socket_utils.send(sock, ("u", delta))
             socket_utils.receive(sock)  # ack
+
+    def barrier_arrive(self, tag: str) -> int:
+        with self._lock:
+            sock = self._connection()
+            socket_utils.send(sock, ("b", tag))
+            return socket_utils.receive(sock)
+
+    def barrier_count(self, tag: str) -> int:
+        with self._lock:
+            sock = self._connection()
+            socket_utils.send(sock, ("c", tag))
+            return socket_utils.receive(sock)
 
     def close(self) -> None:
         with self._lock:

@@ -60,11 +60,34 @@ class LocalServer(BaseParameterServer):
         return LocalClient(self.buffer)
 
 
+class _BarrierBook:
+    """Named arrival counters — the PS doubles as the cross-host control
+    plane (a host "arrives" at a tag; peers poll the count). Chosen over
+    device collectives for teardown barriers because hosts can drift by
+    minutes during async training, far past collective-rendezvous
+    deadlines."""
+
+    def __init__(self):
+        self._counts: dict = {}
+        self._lock = threading.Lock()
+
+    def arrive(self, tag: str) -> int:
+        with self._lock:
+            self._counts[tag] = self._counts.get(tag, 0) + 1
+            return self._counts[tag]
+
+    def count(self, tag: str) -> int:
+        with self._lock:
+            return self._counts.get(tag, 0)
+
+
 class HttpServer(BaseParameterServer):
     """HTTP transport over a ParameterBuffer (reference ``HttpServer``).
 
     Protocol parity: ``GET /parameters`` returns pickled weights,
     ``POST /update`` applies a pickled delta. Runs in a daemon thread.
+    Control-plane extension: ``POST /barrier/<tag>`` (arrive) and
+    ``GET /barrier/<tag>`` (count) back cross-host barriers.
     """
 
     def __init__(
@@ -78,18 +101,28 @@ class HttpServer(BaseParameterServer):
         self.buffer = ParameterBuffer(params, lock=lock, device=device)
         self.host = host if host is not None else _default_bind_host()
         self.port = port
+        self.barriers = _BarrierBook()
         self._httpd = None
         self._thread = None
 
     def start(self) -> None:
         buffer = self.buffer
+        barriers = self.barriers
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # silence per-request stderr spam
                 pass
 
+            def _send_count(self, count: int) -> None:
+                body = str(count).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802
-                if self.path.rstrip("/") == "/parameters":
+                path = self.path.rstrip("/")
+                if path == "/parameters":
                     payload = pickle.dumps(
                         buffer.get_numpy(), protocol=pickle.HIGHEST_PROTOCOL
                     )
@@ -98,17 +131,22 @@ class HttpServer(BaseParameterServer):
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
+                elif path.startswith("/barrier/"):
+                    self._send_count(barriers.count(path[len("/barrier/"):]))
                 else:
                     self.send_error(404)
 
             def do_POST(self):  # noqa: N802
-                if self.path.rstrip("/") == "/update":
+                path = self.path.rstrip("/")
+                if path == "/update":
                     length = int(self.headers.get("Content-Length", 0))
                     delta = pickle.loads(self.rfile.read(length))
                     buffer.apply_delta(delta)
                     self.send_response(200)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
+                elif path.startswith("/barrier/"):
+                    self._send_count(barriers.arrive(path[len("/barrier/"):]))
                 else:
                     self.send_error(404)
 
@@ -140,6 +178,7 @@ class HttpServer(BaseParameterServer):
 class _SocketHandler(socketserver.BaseRequestHandler):
     def handle(self):
         buffer = self.server.buffer  # type: ignore[attr-defined]
+        barriers = self.server.barriers  # type: ignore[attr-defined]
         try:
             while True:
                 kind, payload = socket_utils.receive(self.request)
@@ -148,6 +187,10 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                 elif kind == "u":
                     buffer.apply_delta(payload)
                     socket_utils.send(self.request, b"ok")
+                elif kind == "b":  # barrier arrive(tag) -> count
+                    socket_utils.send(self.request, barriers.arrive(payload))
+                elif kind == "c":  # barrier count(tag)
+                    socket_utils.send(self.request, barriers.count(payload))
                 else:
                     break
         except (ConnectionError, OSError):
@@ -174,12 +217,14 @@ class SocketServer(BaseParameterServer):
         self.buffer = ParameterBuffer(params, lock=lock, device=device)
         self.host = host if host is not None else _default_bind_host()
         self.port = port
+        self.barriers = _BarrierBook()
         self._server = None
         self._thread = None
 
     def start(self) -> None:
         self._server = _ThreadingTCPServer((self.host, self.port), _SocketHandler)
         self._server.buffer = self.buffer  # type: ignore[attr-defined]
+        self._server.barriers = self.barriers  # type: ignore[attr-defined]
         if self.port == 0:
             self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)

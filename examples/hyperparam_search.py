@@ -47,6 +47,10 @@ def main():
     best = search.minimize(objective, data, max_evals=8, space=SPACE, seed=0)
     print("best sample:", best["sample"], "val_acc:", round(best["val_acc"], 4))
 
+    assert best["val_acc"] > 0.85, (
+        f"hyperparam search regressed: best val_acc={best['val_acc']:.3f} <= 0.85"
+    )
+
 
 if __name__ == "__main__":
     main()

@@ -1,37 +1,31 @@
 """BASELINE config 3: IMDB LSTM via the ElephasEstimator pipeline.
 
 Reference workflow (§3.3): DataFrame -> Estimator.fit -> Transformer ->
-DataFrame with predictions. Synthetic IMDB-shaped data: token sequences
-(vocab 2000, len 100), binary sentiment driven by planted token stats.
+DataFrame with predictions. Real IMDB when cached
+(``elephas_tpu.data.datasets``), synthetic token sequences otherwise;
+asserts a held-out accuracy threshold so it doubles as a smoke test.
 """
 
 import numpy as np
 
 from elephas_tpu import ElephasEstimator
 from elephas_tpu.data.dataframe import DataFrame
+from elephas_tpu.data.datasets import load_imdb
 
-
-def synthetic_imdb(n=2048, vocab=2000, seq_len=100, seed=0):
-    rng = np.random.default_rng(seed)
-    labels = rng.integers(0, 2, size=n)
-    # Positive reviews skew toward the upper half of the vocab.
-    low = rng.integers(1, vocab // 2, size=(n, seq_len))
-    high = rng.integers(vocab // 2, vocab, size=(n, seq_len))
-    mask = rng.random((n, seq_len)) < (0.35 + 0.3 * labels)[:, None]
-    tokens = np.where(mask, high, low).astype(np.int32)
-    return tokens, labels.astype(np.float32)
+MAXLEN = 200
+VOCAB = 20000
 
 
 def main():
-    tokens, labels = synthetic_imdb()
-    df = DataFrame({"features": tokens, "label": labels})
+    (xtr, ytr), (xte, yte), real = load_imdb(num_words=VOCAB, maxlen=MAXLEN)
+    df = DataFrame({"features": xtr.astype(np.int32), "label": ytr.astype(np.float32)})
 
     estimator = ElephasEstimator(
         keras_model_config={
             "name": "lstm",
-            "kwargs": {"vocab_size": 2000, "embed_dim": 64, "hidden_dim": 64,
+            "kwargs": {"vocab_size": VOCAB, "embed_dim": 64, "hidden_dim": 64,
                         "num_classes": 2},
-            "input_shape": (100,),
+            "input_shape": (MAXLEN,),
             "input_dtype": "int32",
         },
         mode="synchronous",
@@ -46,10 +40,15 @@ def main():
         categorical=True,
     )
     transformer = estimator.fit(df)
-    out = transformer.transform(df)
-    acc = float(np.mean(out["prediction"] == df["label"]))
-    print(f"pipeline accuracy: {acc:.3f}")
+    test_df = DataFrame(
+        {"features": xte.astype(np.int32), "label": yte.astype(np.float32)}
+    )
+    out = transformer.transform(test_df)
+    acc = float(np.mean(out["prediction"] == test_df["label"]))
+    print(f"pipeline held-out accuracy: {acc:.3f} (real_data: {real})")
     transformer.save("/tmp/imdb_lstm_transformer.pkl")
+
+    assert acc > 0.7, f"IMDB LSTM estimator regressed: held-out acc={acc:.3f} <= 0.7"
 
 
 if __name__ == "__main__":

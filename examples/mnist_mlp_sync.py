@@ -1,39 +1,38 @@
 """BASELINE config 1: MNIST MLP via SparkModel (synchronous, 4 partitions).
 
-Mirrors the reference's ``examples/mnist_mlp_spark.py`` workflow. The
-environment has no network access, so data is synthetic MNIST-shaped
-(28x28 grayscale, 10 classes); swap ``synthetic_mnist`` for a real loader
-when one is available.
+Mirrors the reference's ``examples/mnist_mlp_spark.py`` workflow. Data
+comes from ``elephas_tpu.data.datasets.load_mnist``: the real MNIST when
+``$ELEPHAS_DATA_DIR/mnist.npz`` exists, else a deterministic synthetic
+stand-in. Ends with a threshold assert so it doubles as a smoke test
+(SURVEY.md §4 "examples as smoke tests").
 """
 
 import numpy as np
 
 from elephas_tpu import SparkModel, compile_model, to_simple_rdd
+from elephas_tpu.data.datasets import load_mnist, one_hot
 from elephas_tpu.models import get_model
 
 
-def synthetic_mnist(n=8192, seed=0):
-    rng = np.random.default_rng(seed)
-    prototypes = rng.normal(scale=2.0, size=(10, 28 * 28))
-    labels = rng.integers(0, 10, size=n)
-    x = prototypes[labels] + rng.normal(size=(n, 28 * 28))
-    return x.astype(np.float32).reshape(n, 28, 28), np.eye(10, dtype=np.float32)[labels]
-
-
 def main():
-    x, y = synthetic_mnist()
+    (xtr, ytr), (xte, yte), real = load_mnist()
+    x, y = xtr.astype(np.float32) / 255.0, one_hot(ytr, 10)
+    xv, yv = xte.astype(np.float32) / 255.0, one_hot(yte, 10)
     net = compile_model(
         get_model("mlp", features=(128, 128), num_classes=10, dropout_rate=0.1),
         optimizer={"name": "adam", "learning_rate": 1e-3},
         loss="categorical_crossentropy",
         metrics=["acc"],
-        input_shape=(28, 28),
+        input_shape=x.shape[1:],
     )
     model = SparkModel(net, mode="synchronous", frequency="batch", num_workers=4)
     rdd = to_simple_rdd(None, x, y, num_partitions=4)
-    history = model.fit(rdd, epochs=5, batch_size=32, validation_split=0.1, verbose=1)
-    print("final:", {k: round(v[-1], 4) for k, v in history.items()})
+    history = model.fit(rdd, epochs=5, batch_size=32, validation_data=(xv, yv), verbose=1)
+    print("final:", {k: round(v[-1], 4) for k, v in history.items()}, "real_data:", real)
     model.save("/tmp/mnist_mlp_sync.pkl")
+
+    val_acc = history["val_acc"][-1]
+    assert val_acc > 0.9, f"MNIST MLP sync regressed: val_acc={val_acc:.3f} <= 0.9"
 
 
 if __name__ == "__main__":

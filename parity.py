@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Parity harness: the five BASELINE eval configs, end-to-end.
+
+Runs every driver surface (``SparkModel``, ``ElephasEstimator``,
+``HyperParamModel``) on the BASELINE.json workloads and emits one JSON
+line per config::
+
+    {"config": ..., "mode": ..., "samples_per_sec": ..., "final_val_acc": ...,
+     "real_data": ..., "epochs": ..., "train_rows": ...}
+
+Data resolution: real datasets when present under ``$ELEPHAS_DATA_DIR``
+(see ``elephas_tpu/data/datasets.py`` for drop-in file formats), else
+deterministic synthetic stand-ins — ``real_data`` records which was used;
+only real-data rows are comparable to published MNIST/CIFAR/IMDB numbers.
+
+Usage::
+
+    python parity.py                 # all five configs
+    python parity.py --quick        # small slices (CI smoke)
+    python parity.py --configs mnist_mlp_sync,cifar10_resnet18_hogwild
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _throughput(n_rows: int, epochs: int, seconds: float) -> float:
+    return round(n_rows * epochs / seconds, 2)
+
+
+def _record(name, mode, history, n_rows, epochs, secs, real, extra=None):
+    val_keys = [k for k in history if k.startswith("val_") and "acc" in k]
+    acc_keys = [k for k in history if "acc" in k and not k.startswith("val_")]
+    rec = {
+        "config": name,
+        "mode": mode,
+        "samples_per_sec": _throughput(n_rows, epochs, secs),
+        "final_val_acc": round(float(history[val_keys[0]][-1]), 4) if val_keys else None,
+        "final_train_acc": round(float(history[acc_keys[0]][-1]), 4) if acc_keys else None,
+        "real_data": real,
+        "epochs": epochs,
+        "train_rows": n_rows,
+    }
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+# ----------------------------------------------------------------- configs
+
+
+def mnist_mlp_sync(quick: bool):
+    """BASELINE config 1: MNIST MLP, synchronous, 4 partitions."""
+    from elephas_tpu import SparkModel, compile_model, to_simple_rdd
+    from elephas_tpu.data.datasets import load_mnist, one_hot
+    from elephas_tpu.models import get_model
+
+    (xtr, ytr), (xte, yte), real = load_mnist()
+    if quick:
+        xtr, ytr = xtr[:2048], ytr[:2048]
+        xte, yte = xte[:512], yte[:512]
+    x = xtr.astype(np.float32) / 255.0
+    y = one_hot(ytr, 10)
+    xv = xte.astype(np.float32) / 255.0
+    yv = one_hot(yte, 10)
+    net = compile_model(
+        get_model("mlp", features=(128, 128), num_classes=10, dropout_rate=0.1),
+        optimizer={"name": "adam", "learning_rate": 1e-3},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=x.shape[1:],
+    )
+    epochs = 2 if quick else 5
+    model = SparkModel(net, mode="synchronous", frequency="epoch", num_workers=4)
+    t0 = time.perf_counter()
+    history = model.fit(
+        to_simple_rdd(None, x, y, 4), epochs=epochs, batch_size=32,
+        validation_data=(xv, yv),
+    )
+    secs = time.perf_counter() - t0
+    return _record("mnist_mlp_sync", "synchronous", history, len(x), epochs, secs, real)
+
+
+def mnist_cnn_async(quick: bool):
+    """BASELINE config 2: MNIST CNN, asynchronous PS."""
+    from elephas_tpu import SparkModel, compile_model, to_simple_rdd
+    from elephas_tpu.data.datasets import load_mnist, one_hot
+    from elephas_tpu.models import get_model
+
+    (xtr, ytr), (xte, yte), real = load_mnist()
+    if quick:
+        xtr, ytr = xtr[:2048], ytr[:2048]
+        xte, yte = xte[:512], yte[:512]
+    x = (xtr.astype(np.float32) / 255.0)[..., None]  # NHWC
+    y = one_hot(ytr, 10)
+    xv = (xte.astype(np.float32) / 255.0)[..., None]
+    yv = one_hot(yte, 10)
+    net = compile_model(
+        get_model("cnn", channels=(32, 64), dense_width=128, num_classes=10),
+        optimizer={"name": "adam", "learning_rate": 1e-3},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=x.shape[1:],
+    )
+    epochs = 1 if quick else 3
+    import jax
+
+    n_workers = len(jax.devices())
+    model = SparkModel(net, mode="asynchronous", frequency="epoch", num_workers=n_workers)
+    t0 = time.perf_counter()
+    history = model.fit(
+        to_simple_rdd(None, x, y, n_workers), epochs=epochs, batch_size=64,
+        validation_data=(xv, yv),
+    )
+    secs = time.perf_counter() - t0
+    return _record("mnist_cnn_async", "asynchronous", history, len(x), epochs, secs, real)
+
+
+def imdb_lstm_estimator(quick: bool):
+    """BASELINE config 3: IMDB LSTM through the ML-pipeline estimator."""
+    from elephas_tpu.data.datasets import load_imdb
+    from elephas_tpu.data.dataframe import to_data_frame
+    from elephas_tpu.ml.ml_model import ElephasEstimator
+
+    maxlen = 120 if quick else 200
+    (xtr, ytr), (xte, yte), real = load_imdb(num_words=20000, maxlen=maxlen)
+    if quick:
+        xtr, ytr = xtr[:2048], ytr[:2048]
+        xte, yte = xte[:512], yte[:512]
+    df = to_data_frame(None, xtr.astype(np.float32), ytr.astype(np.float32))
+    epochs = 1 if quick else 2
+    import jax
+
+    n_workers = len(jax.devices())
+    est = ElephasEstimator(
+        keras_model_config={
+            "name": "lstm",
+            "kwargs": {
+                "vocab_size": 20000, "embed_dim": 64, "hidden_dim": 64,
+                "num_classes": 2,
+            },
+            "input_shape": [maxlen],
+            "input_dtype": "int32",
+        },
+        optimizer_config={"name": "adam", "learning_rate": 1e-3},
+        loss="sparse_categorical_crossentropy",
+        metrics=["acc"],
+        mode="synchronous",
+        frequency="epoch",
+        epochs=epochs,
+        batch_size=32,
+        num_workers=n_workers,
+        categorical=False,
+        nb_classes=2,
+    )
+    t0 = time.perf_counter()
+    transformer = est.fit(df)
+    secs = time.perf_counter() - t0
+    out = transformer.transform(
+        to_data_frame(None, xte.astype(np.float32), yte.astype(np.float32))
+    )
+    preds = np.asarray(out["prediction"])
+    val_acc = float((preds.argmax(-1) == yte).mean())
+    history = {"val_acc": [val_acc]}
+    return _record(
+        "imdb_lstm_estimator", "estimator", history, len(xtr), epochs, secs, real
+    )
+
+
+def cifar10_resnet18_hogwild(quick: bool):
+    """BASELINE config 4 (the flagship): CIFAR-10 ResNet-18, hogwild."""
+    from elephas_tpu import SparkModel, compile_model, to_simple_rdd
+    from elephas_tpu.data.datasets import load_cifar10, one_hot
+    from elephas_tpu.models import get_model
+
+    (xtr, ytr), (xte, yte), real = load_cifar10()
+    if quick:
+        xtr, ytr = xtr[:2048], ytr[:2048]
+        xte, yte = xte[:512], yte[:512]
+    mean = np.array([0.4914, 0.4822, 0.4465], np.float32) * 255.0
+    std = np.array([0.247, 0.243, 0.261], np.float32) * 255.0
+    x = (xtr.astype(np.float32) - mean) / std
+    y = one_hot(ytr, 10)
+    xv = (xte.astype(np.float32) - mean) / std
+    yv = one_hot(yte, 10)
+    net = compile_model(
+        get_model("resnet18", num_classes=10, width=16 if quick else 64),
+        optimizer={"name": "momentum", "learning_rate": 0.05},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=x.shape[1:],
+    )
+    epochs = 1 if quick else 3
+    import jax
+
+    n_workers = len(jax.devices())
+    model = SparkModel(net, mode="hogwild", frequency="epoch", num_workers=n_workers)
+    t0 = time.perf_counter()
+    history = model.fit(
+        to_simple_rdd(None, x, y, n_workers), epochs=epochs, batch_size=128,
+        validation_data=(xv, yv),
+    )
+    secs = time.perf_counter() - t0
+    return _record(
+        "cifar10_resnet18_hogwild", "hogwild", history, len(x), epochs, secs, real
+    )
+
+
+def hyperparam_search(quick: bool):
+    """BASELINE config 5: distributed random search (hyperas analogue)."""
+    from elephas_tpu import compile_model
+    from elephas_tpu.data.datasets import load_mnist, one_hot
+    from elephas_tpu.engine.sync import SyncTrainer
+    from elephas_tpu.hyperparam import HyperParamModel, hp
+    from elephas_tpu.models import get_model
+    from elephas_tpu.data.rdd import ShardedDataset
+    from elephas_tpu.parallel.mesh import build_mesh
+
+    (xtr, ytr), (xte, yte), real = load_mnist()
+    n = 2048 if quick else 4096
+    x = xtr[:n].astype(np.float32) / 255.0
+    y = one_hot(ytr[:n], 10)
+    xv = xte[:1024].astype(np.float32) / 255.0
+    yv = one_hot(yte[:1024], 10)
+
+    def objective(sample, data):
+        x, y, xv, yv = data
+        net = compile_model(
+            get_model("mlp", features=(int(sample["width"]),), num_classes=10),
+            optimizer={"name": "adam", "learning_rate": sample["lr"]},
+            loss="categorical_crossentropy",
+            metrics=["acc"],
+            input_shape=x.shape[1:],
+        )
+        # respect the trial worker's pinned device (HyperParamModel sets
+        # jax.default_device per worker thread)
+        dev = jax.config.jax_default_device or jax.devices()[0]
+        mesh = build_mesh(num_data=1, devices=[dev])
+        trainer = SyncTrainer(net, mesh, frequency="batch")
+        state, history = trainer.fit(
+            ShardedDataset(x, y, 1), epochs=1 if quick else 2, batch_size=64
+        )
+        val = trainer.evaluate_state(state, xv, yv)
+        return {"loss": float(val["loss"]), "val_acc": float(val["acc"])}
+
+    import jax
+
+    model = HyperParamModel(None)
+    max_evals = 2 if quick else 6
+    t0 = time.perf_counter()
+    best = model.minimize(
+        objective,
+        lambda: (x, y, xv, yv),
+        max_evals=max_evals,
+        space={"lr": hp.loguniform(np.log(1e-4), np.log(1e-2)), "width": hp.choice([64, 128, 256])},
+    )
+    secs = time.perf_counter() - t0
+    history = {"val_acc": [best["val_acc"]]}
+    rec = _record(
+        "hyperparam_search", "trial-parallel", history, n * max_evals,
+        1 if quick else 2, secs, real,
+        extra={"best_sample": best["sample"], "trials": max_evals},
+    )
+    return rec
+
+
+CONFIGS = {
+    "mnist_mlp_sync": mnist_mlp_sync,
+    "mnist_cnn_async": mnist_cnn_async,
+    "imdb_lstm_estimator": imdb_lstm_estimator,
+    "cifar10_resnet18_hogwild": cifar10_resnet18_hogwild,
+    "hyperparam_search": hyperparam_search,
+}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true", help="small slices (smoke)")
+    parser.add_argument("--configs", default=",".join(CONFIGS))
+    parser.add_argument("--out", default="parity_results.jsonl")
+    args = parser.parse_args()
+
+    names = [n.strip() for n in args.configs.split(",") if n.strip()]
+    unknown = set(names) - set(CONFIGS)
+    if unknown:
+        raise SystemExit(f"unknown configs: {sorted(unknown)}; known: {sorted(CONFIGS)}")
+
+    records = []
+    for name in names:
+        rec = CONFIGS[name](args.quick)
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+    with open(args.out, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Dataset loader tests: shapes, determinism, cache-path resolution."""
+
+import numpy as np
+
+from elephas_tpu.data import datasets
+
+
+def test_synthetic_mnist_shapes_and_determinism():
+    (x1, y1), (xt1, yt1) = datasets.synthetic_mnist(n_train=256, n_test=64)
+    (x2, y2), _ = datasets.synthetic_mnist(n_train=256, n_test=64)
+    assert x1.shape == (256, 28, 28) and x1.dtype == np.uint8
+    assert yt1.shape == (64,)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+    assert set(np.unique(y1)) <= set(range(10))
+
+
+def test_synthetic_cifar_shapes():
+    (x, y), (xt, yt) = datasets.synthetic_cifar10(n_train=128, n_test=32)
+    assert x.shape == (128, 32, 32, 3) and x.dtype == np.uint8
+    assert xt.shape == (32, 32, 32, 3)
+
+
+def test_synthetic_imdb_padding_and_labels():
+    (x, y), _ = datasets.synthetic_imdb(n_train=64, n_test=16, num_words=500, maxlen=50)
+    assert x.shape == (64, 50) and x.dtype == np.int32
+    assert x.max() < 500
+    assert set(np.unique(y)) <= {0, 1}
+    # pre-padding: rows start with zeros, end with tokens
+    row = x[0]
+    nz = np.nonzero(row)[0]
+    assert len(nz) > 0 and nz[-1] == 49
+
+
+def test_loader_prefers_local_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("ELEPHAS_DATA_DIR", str(tmp_path))
+    rng = np.random.default_rng(0)
+    np.savez(
+        tmp_path / "mnist.npz",
+        x_train=rng.integers(0, 255, (32, 28, 28), dtype=np.uint8),
+        y_train=rng.integers(0, 10, 32),
+        x_test=rng.integers(0, 255, (8, 28, 28), dtype=np.uint8),
+        y_test=rng.integers(0, 10, 8),
+    )
+    (xtr, ytr), (xte, yte), real = datasets.load_mnist()
+    assert real is True
+    assert xtr.shape == (32, 28, 28) and xte.shape == (8, 28, 28)
+
+
+def test_loader_synthetic_fallback(tmp_path, monkeypatch):
+    monkeypatch.setenv("ELEPHAS_DATA_DIR", str(tmp_path / "missing"))
+    (_, _), (_, _), real = datasets.load_mnist()
+    assert real is False
+
+
+def test_one_hot():
+    y = datasets.one_hot(np.array([0, 2, 1]), 3)
+    np.testing.assert_array_equal(y, np.eye(3, dtype=np.float32)[[0, 2, 1]])
