@@ -22,6 +22,7 @@ reference, where the driver averages weights, never optimizer slots).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -78,8 +79,35 @@ class AsyncTrainer:
         self._subtract = jax.jit(subtract_params)
         self._epoch_fn = jax.jit(make_epoch_scanner(self._train_step))
         self._step_fn = jax.jit(self._train_step)
+        self._local_eval_fn = None  # lazily-jitted single-device evaluator
         # Distinct, collision-free per-worker/per-step dropout streams.
         self._base_rng = jax.random.PRNGKey(977)
+
+    def _local_evaluate(
+        self, state: TrainState, features, labels, batch_size: int = 256
+    ) -> Dict[str, float]:
+        """Single-device exact weighted-mean evaluation — used where a
+        global-mesh SPMD evaluate can't run (host-0 epoch barriers in
+        multi-host async are local, so a collective would desync peers)."""
+        if self._local_eval_fn is None:
+            from elephas_tpu.engine.step import make_eval_step
+
+            self._local_eval_fn = jax.jit(make_eval_step(self.compiled))
+        n = len(features)
+        usable = (n // batch_size) * batch_size
+        spans = [(s, s + batch_size) for s in range(0, usable, batch_size)]
+        if usable < n:
+            spans.append((usable, n))
+        totals: Dict[str, float] = {}
+        for start, stop in spans:
+            metrics = jax.device_get(
+                self._local_eval_fn(
+                    state, jnp.asarray(features[start:stop]), jnp.asarray(labels[start:stop])
+                )
+            )
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * (stop - start)
+        return {k: v / n for k, v in totals.items()}
 
     # -------------------------------------------------------------------------
 
@@ -159,9 +187,23 @@ class AsyncTrainer:
         # observational only), fire callbacks and evaluate validation on a
         # snapshot of the server's current weights, so val_* history has one
         # entry per epoch like SyncTrainer's.
+        #
+        # Multi-host: barrier work runs on HOST 0 ONLY — its barrier is
+        # local, so the snapshot samples whatever global progress the PS
+        # holds when host 0's workers finish epoch e (honest per-epoch
+        # sampling; exact global barriers would reintroduce the lockstep
+        # async mode exists to avoid). State-persisting callbacks
+        # (checkpointing) are therefore host-0-only under async multi-host:
+        # Orbax saves are collective when jax.distributed is live, and
+        # unsynchronized per-host fires would deadlock or collide.
+        is_driver = not multi_host or jax.process_index() == 0
+        run_callbacks = tuple(callbacks) if is_driver else ()
+        do_val = validation_data is not None and is_driver
         epoch_done_counts = [0] * epochs
         epochs_fired = 0
         barrier_lock = threading.Lock()
+        fire_lock = threading.Lock()  # serializes barrier work (snapshot/val/callbacks)
+        fire_queue: deque = deque()
         val_records: List[Optional[Dict[str, float]]] = [None] * epochs
         val_trainer = None
 
@@ -170,35 +212,26 @@ class AsyncTrainer:
                 return jax.device_get(server.get_parameters())
             return remote_client_factory().get_parameters()
 
-        def on_epoch_done(epoch: int) -> None:
-            nonlocal epochs_fired, val_trainer
-            if not callbacks and (validation_data is None or multi_host):
-                return
-            fire = None
-            with barrier_lock:
-                epoch_done_counts[epoch] += 1
-                if (
-                    epoch == epochs_fired
-                    and epoch_done_counts[epoch] == self.n_workers
-                ):
-                    fire = epoch
-                    epochs_fired += 1
-            if fire is not None:
-                snapshot = pull_snapshot()
-                # step must advance per epoch or rotating checkpointers
-                # (keyed on state.step) silently drop every save after the
-                # first — Orbax no-ops on an already-saved step.
-                snap_state = TrainState.create(
-                    params=snapshot["params"],
-                    opt_state=compiled.init_opt_state(snapshot["params"]),
-                    batch_stats=snapshot["batch_stats"],
-                    step=fire + 1,
-                )
-                if validation_data is not None and not multi_host:
-                    # Multi-host: the epoch barrier here is *local*; a
-                    # global-mesh SPMD evaluate from unsynchronized barrier
-                    # threads would desync collectives, so validation runs
-                    # on the final state after fit instead.
+        def do_fire(fire: int) -> None:
+            nonlocal val_trainer
+            snapshot = pull_snapshot()
+            # step must advance per epoch or rotating checkpointers
+            # (keyed on state.step) silently drop every save after the
+            # first — Orbax no-ops on an already-saved step.
+            snap_state = TrainState.create(
+                params=snapshot["params"],
+                opt_state=compiled.init_opt_state(snapshot["params"]),
+                batch_stats=snapshot["batch_stats"],
+                step=fire + 1,
+            )
+            if do_val:
+                if multi_host:
+                    # Local single-device eval: the global-mesh SPMD
+                    # evaluate would desync peers (barrier is host-local).
+                    val_records[fire] = self._local_evaluate(
+                        snap_state, *validation_data
+                    )
+                else:
                     if val_trainer is None:
                         from elephas_tpu.engine.sync import SyncTrainer
 
@@ -208,8 +241,32 @@ class AsyncTrainer:
                     val_records[fire] = val_trainer.evaluate_state(
                         snap_state, *validation_data
                     )
-                for cb in callbacks:
-                    cb(fire, snap_state, {})
+            for cb in run_callbacks:
+                cb(fire, snap_state, {})
+
+        def on_epoch_done(epoch: int) -> None:
+            nonlocal epochs_fired
+            if not run_callbacks and not do_val:
+                return
+            with barrier_lock:
+                epoch_done_counts[epoch] += 1
+                while (
+                    epochs_fired < epochs
+                    and epoch_done_counts[epochs_fired] == self.n_workers
+                ):
+                    fire_queue.append(epochs_fired)
+                    epochs_fired += 1
+            # Serial FIFO drain under fire_lock: at most one epoch's
+            # barrier work runs at a time, in epoch order — concurrent
+            # fires raced val_trainer creation and Orbax saves are not
+            # thread-safe (advisor r2).
+            while True:
+                with fire_lock:
+                    with barrier_lock:
+                        if not fire_queue:
+                            return
+                    fire = fire_queue.popleft()
+                    do_fire(fire)
 
         def worker(slot: int, global_index: int, device: jax.Device) -> None:
             try:
@@ -274,21 +331,63 @@ class AsyncTrainer:
             batch_stats=final["batch_stats"],
             rng=rng if rng is not None else jax.random.PRNGKey(0),
         )
-        history: Dict[str, List[float]] = {}
-        for epoch in range(epochs):
-            epoch_dicts = [m[epoch] for m in per_worker_metrics if m is not None]
-            for key in epoch_dicts[0]:
-                history.setdefault(key, []).append(
-                    float(np.mean([d[key] for d in epoch_dicts]))
+        # Train-metric history: mean over ALL workers job-wide. Multi-host:
+        # allgather each host's per-epoch means weighted by its local worker
+        # count, so every host reports the identical history a single-host
+        # run of the same job would (hosts are already re-synchronized by
+        # the PS teardown barriers above, so the collective is safe).
+        worker_histories = [m for m in per_worker_metrics if m is not None]
+        keys = sorted(worker_histories[0][0].keys())
+        local_means = np.array(
+            [[np.mean([m[e][k] for m in worker_histories]) for k in keys]
+             for e in range(epochs)],
+            dtype=np.float64,
+        )  # (epochs, nkeys)
+        if multi_host:
+            from jax.experimental import multihost_utils
+
+            counts = np.asarray(
+                multihost_utils.process_allgather(
+                    np.array([len(worker_histories)], dtype=np.float64)
                 )
+            ).reshape(-1)  # (nhosts,)
+            all_means = np.asarray(
+                multihost_utils.process_allgather(local_means)
+            ).reshape(-1, epochs, len(keys))
+            local_means = (
+                all_means * counts[:, None, None]
+            ).sum(axis=0) / counts.sum()
+        history: Dict[str, List[float]] = {
+            k: [float(local_means[e, i]) for e in range(epochs)]
+            for i, k in enumerate(keys)
+        }
         if validation_data is not None:
+            if multi_host:
+                # Host 0 evaluated the PS snapshot at each of its epoch
+                # barriers; ship those records to every host so val_*
+                # history is identical job-wide (same shape/semantics as
+                # single-host: one PS-snapshot eval per epoch).
+                import json as _json
+
+                from elephas_tpu.parallel import distributed
+
+                val_records = _json.loads(
+                    distributed.broadcast_bytes_from_host0(
+                        _json.dumps(val_records).encode()
+                    ).decode()
+                )
+            fallback = None  # evaluate the final state at most ONCE
             for epoch, val in enumerate(val_records):
                 if val is None:  # defensive: every barrier fires when no worker errored
-                    if val_trainer is None:
-                        from elephas_tpu.engine.sync import SyncTrainer
+                    if fallback is None:
+                        if val_trainer is None:
+                            from elephas_tpu.engine.sync import SyncTrainer
 
-                        val_trainer = SyncTrainer(compiled, self.mesh, frequency="batch")
-                    val = val_trainer.evaluate_state(state, *validation_data)
+                            val_trainer = SyncTrainer(
+                                compiled, self.mesh, frequency="batch"
+                            )
+                        fallback = val_trainer.evaluate_state(state, *validation_data)
+                    val = fallback
                 for k, v in val.items():
                     history.setdefault(f"val_{k}", []).append(v)
         if verbose:

@@ -324,10 +324,13 @@ class SyncTrainer:
         # Stacked state: leading shard axis; per-shard dropout streams.
         base_rng = state.rng
         shard_rngs = jax.random.split(base_rng, n_shards)
+        # The rng leaf is replaced by shard_rngs below; broadcast a dummy in
+        # its place — np.asarray on a typed PRNG key (jax.random.key)
+        # raises TypeError, so it must not go through the numpy broadcast.
         state_block = jax.device_put(
             jax.tree_util.tree_map(
                 lambda l: np.broadcast_to(np.asarray(l), (n_shards,) + np.shape(l)),
-                state,
+                state.replace(rng=np.zeros((), np.uint32)),
             ),
             state_sharding,
         )

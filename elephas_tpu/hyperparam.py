@@ -35,6 +35,17 @@ class _Dist:
     def sample(self, rng: np.random.Generator):
         raise NotImplementedError
 
+    # -- numeric-KDE interface (TPE). Choice overrides with categorical logic.
+    def warp(self, value) -> float:
+        """Map a sampled value into the continuous domain the TPE kernel
+        density lives in (log-space for loguniform, identity otherwise)."""
+        return float(value)
+
+    @property
+    def span(self) -> float:
+        """Width of the warped domain (bandwidth floor for the KDE)."""
+        raise NotImplementedError
+
 
 class _Choice(_Dist):
     def __init__(self, options):
@@ -51,6 +62,10 @@ class _Uniform(_Dist):
     def sample(self, rng):
         return float(rng.uniform(self.low, self.high))
 
+    @property
+    def span(self):
+        return float(self.high - self.low)
+
 
 class _LogUniform(_Dist):
     def __init__(self, low, high):
@@ -60,6 +75,13 @@ class _LogUniform(_Dist):
     def sample(self, rng):
         return float(np.exp(rng.uniform(self.low, self.high)))
 
+    def warp(self, value):
+        return float(np.log(value))
+
+    @property
+    def span(self):
+        return float(self.high - self.low)
+
 
 class _QUniform(_Dist):
     def __init__(self, low, high, q):
@@ -68,6 +90,10 @@ class _QUniform(_Dist):
     def sample(self, rng):
         return float(np.round(rng.uniform(self.low, self.high) / self.q) * self.q)
 
+    @property
+    def span(self):
+        return float(self.high - self.low)
+
 
 class _RandInt(_Dist):
     def __init__(self, upper):
@@ -75,6 +101,10 @@ class _RandInt(_Dist):
 
     def sample(self, rng):
         return int(rng.integers(self.upper))
+
+    @property
+    def span(self):
+        return float(self.upper)
 
 
 class hp:
@@ -98,6 +128,111 @@ def sample_space(space: Any, rng: np.random.Generator) -> Any:
     return space
 
 
+def _iter_nodes(space: Any, path=()):
+    """Yield (path, dist) for every ``hp.*`` node in the nested space."""
+    if isinstance(space, _Dist):
+        yield path, space
+    elif isinstance(space, dict):
+        for k, v in space.items():
+            yield from _iter_nodes(v, path + (k,))
+    elif isinstance(space, (list, tuple)):
+        for i, v in enumerate(space):
+            yield from _iter_nodes(v, path + (i,))
+
+
+def _substitute(space: Any, values: Dict, path=()):
+    """Rebuild the space structure with ``values[path]`` at each hp node."""
+    if isinstance(space, _Dist):
+        return values[path]
+    if isinstance(space, dict):
+        return {k: _substitute(v, values, path + (k,)) for k, v in space.items()}
+    if isinstance(space, (list, tuple)):
+        return type(space)(
+            _substitute(v, values, path + (i,)) for i, v in enumerate(space)
+        )
+    return space
+
+
+class _RandomSampler:
+    """Pure random search (``algo='random'``) — the r1/r2 behavior."""
+
+    def __init__(self, space: Any, rng: np.random.Generator):
+        self.space = space
+        self.rng = rng
+        self.nodes = list(_iter_nodes(space))
+
+    def suggest(self):
+        values = {path: dist.sample(self.rng) for path, dist in self.nodes}
+        return values, _substitute(self.space, values)
+
+    def observe(self, values: Dict, loss: float) -> None:
+        pass
+
+
+class _TPESampler(_RandomSampler):
+    """TPE-lite: within-worker *adaptive* sampling (``algo='tpe'``).
+
+    The reference runs sequential ``hyperopt.fmin`` (default algo: TPE)
+    inside each executor (SURVEY.md §3.4) — adaptive within a worker,
+    independent across workers. This is the same shape: after
+    ``n_startup`` random trials, observations are split at the ``gamma``
+    quantile into good/bad sets; each of ``n_candidates`` prior draws is
+    scored by the factorized density ratio l(x)/g(x) (per-node Gaussian
+    KDE in the warped domain for numeric nodes, add-one-smoothed
+    categorical for ``hp.choice``) and the argmax is evaluated. Like
+    hyperopt, nodes are treated independently.
+    """
+
+    def __init__(self, space, rng, n_startup: int = 5, n_candidates: int = 24,
+                 gamma: float = 0.25):
+        super().__init__(space, rng)
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.gamma = gamma
+        self.history: List[tuple] = []  # (values, loss)
+
+    def observe(self, values: Dict, loss: float) -> None:
+        self.history.append((values, float(loss)))
+
+    def _node_log_density(self, path, dist, value, observations) -> float:
+        obs = [o[path] for o in observations]
+        if isinstance(dist, _Choice):
+            try:
+                matches = sum(1 for o in obs if o == value)
+            except Exception:
+                matches = 0
+            return float(
+                np.log((matches + 1.0) / (len(obs) + len(dist.options)))
+            )
+        w = dist.warp(value)
+        ws = np.array([dist.warp(o) for o in obs], dtype=np.float64)
+        sigma = max(float(np.std(ws)), 0.05 * dist.span, 1e-12)
+        logps = -0.5 * ((w - ws) / sigma) ** 2 - np.log(sigma)
+        return float(np.logaddexp.reduce(logps) - np.log(len(ws)))
+
+    def suggest(self):
+        if not self.nodes or len(self.history) < self.n_startup:
+            return super().suggest()
+        ordered = sorted(self.history, key=lambda t: t[1])
+        n_good = max(1, int(np.ceil(self.gamma * len(ordered))))
+        good = [v for v, _ in ordered[:n_good]]
+        bad = [v for v, _ in ordered[n_good:]] or good
+        best_score, best_values = -np.inf, None
+        for _ in range(self.n_candidates):
+            values = {path: dist.sample(self.rng) for path, dist in self.nodes}
+            score = sum(
+                self._node_log_density(path, dist, values[path], good)
+                - self._node_log_density(path, dist, values[path], bad)
+                for path, dist in self.nodes
+            )
+            if score > best_score:
+                best_score, best_values = score, values
+        return best_values, _substitute(self.space, best_values)
+
+
+_SAMPLERS = {"random": _RandomSampler, "tpe": _TPESampler}
+
+
 class HyperParamModel:
     """Distributed random search with per-worker independent streams.
 
@@ -118,6 +253,7 @@ class HyperParamModel:
         max_evals: int = 10,
         space: Optional[Dict] = None,
         seed: int = 0,
+        algo: str = "tpe",
     ):
         """Run ``max_evals`` trials split across workers; return the best
         trial dict (``{"loss", "model", "sample", ...}``).
@@ -125,9 +261,13 @@ class HyperParamModel:
         ``model``: objective ``(sample, data) -> {"loss", "model", ...}``.
         ``data``: zero-arg callable returning the dataset given to every
         trial (the reference's hyperas ``data`` function).
+        ``algo``: ``'tpe'`` (default — within-worker adaptive, matching
+        the reference's per-executor ``hyperopt.fmin``) or ``'random'``.
         """
         if space is None:
             space = {}
+        if algo not in _SAMPLERS:
+            raise ValueError(f"algo must be one of {sorted(_SAMPLERS)}, got {algo!r}")
         dataset = data() if callable(data) else data
         # Exactly max_evals trials total: worker i takes the remainder's
         # i-th extra trial (idle workers get zero).
@@ -139,14 +279,16 @@ class HyperParamModel:
 
         def worker(index: int, device) -> None:
             # Independent stream per worker — the reference's independent
-            # Trials() semantics (§3.4 note).
+            # Trials() semantics (§3.4 note); the sampler is adaptive only
+            # *within* this worker, exactly like per-executor fmin.
             # SeedSequence spawning: collision-free across (seed, worker)
             # pairs, unlike arithmetic seed mixing.
             rng = np.random.default_rng([seed, index])
+            sampler = _SAMPLERS[algo](space, rng)
             try:
                 with jax.default_device(device):
                     for trial in range(trials_for[index]):
-                        sample = sample_space(space, rng)
+                        values, sample = sampler.suggest()
                         out = model(sample, dataset)
                         if not isinstance(out, dict) or "loss" not in out:
                             raise TypeError(
@@ -157,6 +299,7 @@ class HyperParamModel:
                         out["worker"] = index
                         out["trial"] = trial
                         results[index].append(out)
+                        sampler.observe(values, float(out["loss"]))
             except BaseException as exc:
                 errors.append(exc)
 

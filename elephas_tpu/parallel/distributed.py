@@ -98,6 +98,26 @@ def broadcast_from_host0(value: str, max_bytes: int = _ADDR_BYTES) -> str:
     return bytes(out[out != 0]).decode()
 
 
+def broadcast_bytes_from_host0(payload: bytes) -> bytes:
+    """Broadcast an arbitrary-length byte string from host 0 (two-phase:
+    fixed-size length frame, then a frame of exactly that length, so both
+    collectives have identical static shapes on every process). No-op
+    single-host."""
+    if jax.process_count() == 1:
+        return payload
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    length = np.zeros(1, dtype=np.int64)
+    if is_host0():
+        length[0] = len(payload)
+    n = int(np.asarray(multihost_utils.broadcast_one_to_all(length))[0])
+    frame = np.zeros(n, dtype=np.uint8)
+    if is_host0():
+        frame[:] = np.frombuffer(payload, dtype=np.uint8)
+    return np.asarray(multihost_utils.broadcast_one_to_all(frame)).tobytes()
+
+
 def parameter_server_address(port: int = 4000) -> str:
     """Where async workers on any host reach the PS (host 0).
 

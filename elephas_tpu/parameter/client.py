@@ -4,6 +4,13 @@
 (SURVEY.md §2.1 "PS clients"); ``LocalClient`` is the TPU-native
 in-process fast path — a pull is a device-to-device copy out of the HBM
 buffer, a push is a jitted on-device subtract.
+
+Failure model: the reference inherits Spark's task-retry safety net; we
+have none (SURVEY.md §5.3), so the wire clients fail FAST instead of
+hanging — connection-level failures are retried with exponential backoff
+for a small budget (~3s), then raised as ``ParameterServerUnavailable``
+naming the address, so a dead PS surfaces as an actionable error within
+seconds rather than a 60s socket stall per call.
 """
 
 from __future__ import annotations
@@ -12,6 +19,7 @@ import pickle
 import socket
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import jax
@@ -19,6 +27,38 @@ import jax
 from elephas_tpu.parameter.base import BaseParameterClient
 from elephas_tpu.parameter.buffer import ParameterBuffer
 from elephas_tpu.utils import sockets as socket_utils
+
+# Connection-failure retry schedule: total sleep ~2.8s before giving up.
+_RETRY_DELAYS = (0.1, 0.2, 0.4, 0.8, 1.3)
+_CONNECT_TIMEOUT = 2.0  # dial budget per attempt (transfers get self.timeout)
+
+
+class ParameterServerUnavailable(ConnectionError):
+    """The parameter server could not be reached after retries."""
+
+
+def _retry_connect(fn, address: str, op: str):
+    """Run ``fn`` retrying connection-level failures with backoff.
+
+    Anything that indicates the server is *gone* (refused, reset, DNS,
+    dial timeout) is retried then converted to ParameterServerUnavailable;
+    application-level errors (HTTP 4xx/5xx) propagate immediately.
+    """
+    last: Exception | None = None
+    for delay in (*_RETRY_DELAYS, None):
+        try:
+            return fn()
+        except urllib.error.HTTPError:
+            raise  # server alive, request bad — not a connectivity issue
+        except (ConnectionError, socket.timeout, TimeoutError, OSError, urllib.error.URLError) as exc:
+            last = exc
+        if delay is None:
+            break
+        time.sleep(delay)
+    raise ParameterServerUnavailable(
+        f"parameter server at {address} unreachable during {op} "
+        f"(retried {len(_RETRY_DELAYS)}x over ~{sum(_RETRY_DELAYS):.1f}s): {last}"
+    ) from last
 
 
 class LocalClient(BaseParameterClient):
@@ -64,42 +104,73 @@ class _WireBarrierMixin:
 
 
 class HttpClient(_WireBarrierMixin, BaseParameterClient):
-    """urllib against ``GET /parameters`` / ``POST /update``."""
+    """urllib against ``GET /parameters`` / ``POST /update``.
+
+    ``timeout`` bounds the transfer once connected; dialing a dead/absent
+    server fails within ``_CONNECT_TIMEOUT`` per attempt and is retried by
+    ``_retry_connect`` (fail-fast, see module docstring).
+    """
 
     def __init__(self, master_url: str, timeout: float = 60.0):
         self.master_url = master_url
         self.timeout = timeout
 
+    def _url(self, path: str) -> str:
+        return f"http://{self.master_url}{path}"
+
     def get_parameters(self):
-        with urllib.request.urlopen(
-            f"http://{self.master_url}/parameters", timeout=self.timeout
-        ) as resp:
-            return pickle.loads(resp.read())
+        def attempt():
+            with urllib.request.urlopen(
+                self._url("/parameters"), timeout=self.timeout
+            ) as resp:
+                return pickle.loads(resp.read())
+
+        return _retry_connect(attempt, self.master_url, "get_parameters")
 
     def update_parameters(self, delta) -> None:
         delta = jax.device_get(delta)
         payload = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)
-        req = urllib.request.Request(
-            f"http://{self.master_url}/update",
-            data=payload,
-            headers={"Content-Type": "application/octet-stream"},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout):
-            pass
+
+        def attempt():
+            req = urllib.request.Request(
+                self._url("/update"),
+                data=payload,
+                headers={"Content-Type": "application/octet-stream"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout):
+                pass
+
+        _retry_connect(attempt, self.master_url, "update_parameters")
+
+    def health(self) -> bool:
+        """One non-retried probe of ``GET /health`` (liveness check)."""
+        try:
+            with urllib.request.urlopen(
+                self._url("/health"), timeout=_CONNECT_TIMEOUT
+            ) as resp:
+                return resp.status == 200
+        except Exception:
+            return False
 
     def barrier_arrive(self, tag: str) -> int:
-        req = urllib.request.Request(
-            f"http://{self.master_url}/barrier/{tag}", data=b"", method="POST"
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return int(resp.read())
+        def attempt():
+            req = urllib.request.Request(
+                self._url(f"/barrier/{tag}"), data=b"", method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return int(resp.read())
+
+        return _retry_connect(attempt, self.master_url, "barrier_arrive")
 
     def barrier_count(self, tag: str) -> int:
-        with urllib.request.urlopen(
-            f"http://{self.master_url}/barrier/{tag}", timeout=self.timeout
-        ) as resp:
-            return int(resp.read())
+        def attempt():
+            with urllib.request.urlopen(
+                self._url(f"/barrier/{tag}"), timeout=self.timeout
+            ) as resp:
+                return int(resp.read())
+
+        return _retry_connect(attempt, self.master_url, "barrier_count")
 
 
 def make_client(mode: str, address: str) -> BaseParameterClient:
@@ -119,41 +190,69 @@ def make_client(mode: str, address: str) -> BaseParameterClient:
 class SocketClient(_WireBarrierMixin, BaseParameterClient):
     """Persistent framed-TCP connection (one per worker thread)."""
 
-    def __init__(self, master_url: str):
+    def __init__(self, master_url: str, timeout: float = 60.0):
         host, port = master_url.rsplit(":", 1)
+        self.master_url = master_url
         self._addr = (host, int(port))
+        self.timeout = timeout
         self._sock = None
         self._lock = threading.Lock()  # one in-flight request per connection
 
     def _connection(self) -> socket.socket:
         if self._sock is None:
-            self._sock = socket.create_connection(self._addr, timeout=60.0)
+            def attempt():
+                sock = socket.create_connection(self._addr, timeout=_CONNECT_TIMEOUT)
+                sock.settimeout(self.timeout)
+                return sock
+
+            self._sock = _retry_connect(attempt, self.master_url, "connect")
         return self._sock
+
+    def _roundtrip(self, frame, op: str):
+        """Send one frame, read one reply; a connection that died between
+        calls (PS restart) gets ONE reconnect, then fails fast."""
+        for retry in (True, False):
+            sock = self._connection()
+            try:
+                socket_utils.send(sock, frame)
+                return socket_utils.receive(sock)
+            except (ConnectionError, socket.timeout, TimeoutError, OSError) as exc:
+                self._sock = None
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if not retry:
+                    raise ParameterServerUnavailable(
+                        f"parameter server at {self.master_url} dropped the "
+                        f"connection during {op}: {exc}"
+                    ) from exc
 
     def get_parameters(self):
         with self._lock:
-            sock = self._connection()
-            socket_utils.send(sock, ("g", None))
-            return socket_utils.receive(sock)
+            return self._roundtrip(("g", None), "get_parameters")
 
     def update_parameters(self, delta) -> None:
         delta = jax.device_get(delta)
         with self._lock:
-            sock = self._connection()
-            socket_utils.send(sock, ("u", delta))
-            socket_utils.receive(sock)  # ack
+            self._roundtrip(("u", delta), "update_parameters")
+
+    def health(self) -> bool:
+        """Liveness probe: a barrier *count* is read-only and cheap."""
+        try:
+            with self._lock:
+                self._roundtrip(("c", "health"), "health")
+            return True
+        except Exception:
+            return False
 
     def barrier_arrive(self, tag: str) -> int:
         with self._lock:
-            sock = self._connection()
-            socket_utils.send(sock, ("b", tag))
-            return socket_utils.receive(sock)
+            return self._roundtrip(("b", tag), "barrier_arrive")
 
     def barrier_count(self, tag: str) -> int:
         with self._lock:
-            sock = self._connection()
-            socket_utils.send(sock, ("c", tag))
-            return socket_utils.receive(sock)
+            return self._roundtrip(("c", tag), "barrier_count")
 
     def close(self) -> None:
         with self._lock:

@@ -35,6 +35,16 @@ def _default_bind_host() -> str:
     return os.environ.get("ELEPHAS_PS_BIND", "127.0.0.1")
 
 
+def _dial_host(bind_host: str) -> str:
+    """Address a same-host client should dial for a server bound to
+    ``bind_host``. A wildcard bind listens on loopback too, so dial
+    127.0.0.1; a concrete bind (e.g. ``ELEPHAS_PS_BIND=10.0.0.5``) does
+    NOT listen on loopback, so the client must dial that address."""
+    if bind_host in ("", "0.0.0.0", "::", "*"):
+        return "127.0.0.1"
+    return bind_host
+
+
 class LocalServer(BaseParameterServer):
     """In-process server: workers share the HBM buffer directly.
 
@@ -122,7 +132,13 @@ class HttpServer(BaseParameterServer):
 
             def do_GET(self):  # noqa: N802
                 path = self.path.rstrip("/")
-                if path == "/parameters":
+                if path == "/health":
+                    body = b"ok"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/parameters":
                     payload = pickle.dumps(
                         buffer.get_numpy(), protocol=pickle.HIGHEST_PROTOCOL
                     )
@@ -172,7 +188,7 @@ class HttpServer(BaseParameterServer):
     def client(self):
         from elephas_tpu.parameter.client import HttpClient
 
-        return HttpClient(f"127.0.0.1:{self.port}")
+        return HttpClient(f"{_dial_host(self.host)}:{self.port}")
 
 
 class _SocketHandler(socketserver.BaseRequestHandler):
@@ -242,7 +258,7 @@ class SocketServer(BaseParameterServer):
     def client(self):
         from elephas_tpu.parameter.client import SocketClient
 
-        return SocketClient(f"127.0.0.1:{self.port}")
+        return SocketClient(f"{_dial_host(self.host)}:{self.port}")
 
 
 def make_server(
