@@ -23,7 +23,7 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE = os.path.join(REPO, ".bench_cpu_baseline.json")
 
-BATCH_TPU = 512
+BATCH_TPU = 2048  # sweep-selected: +5% over 512 at bf16 norms (PROFILE.md §1)
 BATCH_CPU = 64
 WARMUP = 5
 MEASURE = 50
@@ -55,6 +55,8 @@ def measure_train_rate(batch_size: int, steps: int, warmup: int, dtype: str) -> 
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch_size)]
     # Pin everything to ONE device: the metric is samples/sec/chip, so the
     # measurement itself must be single-chip even on a multi-chip host.
+    # Inputs stay float32 — what the shipped trainers actually feed
+    # (sweeps showed bf16 input is within noise anyway, PROFILE.md §1).
     device = jax.devices()[0]
     x, y = jax.device_put(x, device), jax.device_put(y, device)
 
@@ -75,18 +77,32 @@ def measure_train_rate(batch_size: int, steps: int, warmup: int, dtype: str) -> 
     return batch_size * steps / dt
 
 
+CPU_STEPS = 20  # ≥20 measured steps (VERDICT r2 #7); two runs, variance-checked
+
+
 def cpu_baseline_rate() -> float:
+    """Per-worker CPU train-step rate — the stand-in for the reference's
+    TF-CPU Spark executor (an approximation: same model/batch, JAX-CPU
+    instead of TF-CPU). Measured over two independent ``CPU_STEPS``-step
+    runs in one subprocess; cached with the run-to-run spread recorded.
+    """
     if os.path.exists(CACHE):
         with open(CACHE) as f:
-            return json.load(f)["samples_per_sec"]
+            cached = json.load(f)
+        # Only trust caches produced by the current methodology — a stale
+        # record from the old single 3-step run would silently keep the
+        # noisy baseline this measurement replaced.
+        if cached.get("steps") == CPU_STEPS and len(cached.get("runs", [])) >= 2:
+            return cached["samples_per_sec"]
+        log("stale CPU baseline cache (old methodology); re-measuring")
     log("measuring CPU per-worker baseline (one-time, cached)...")
     code = (
         "import jax, json, sys;"
         "jax.config.update('jax_platforms','cpu');"
         "sys.path.insert(0, %r);"
         "from bench import measure_train_rate;"
-        "print(json.dumps(measure_train_rate(%d, 3, 1, 'float32')))"
-        % (REPO, BATCH_CPU)
+        "rates=[measure_train_rate(%d, %d, 2, 'float32') for _ in range(2)];"
+        "print(json.dumps(rates))" % (REPO, BATCH_CPU, CPU_STEPS)
     )
     out = subprocess.run(
         [sys.executable, "-c", code],
@@ -98,9 +114,22 @@ def cpu_baseline_rate() -> float:
     if out.returncode != 0:
         log("CPU baseline failed:", out.stderr[-2000:])
         raise RuntimeError("cpu baseline subprocess failed")
-    rate = float(out.stdout.strip().splitlines()[-1])
+    rates = json.loads(out.stdout.strip().splitlines()[-1])
+    rate = sum(rates) / len(rates)
+    spread = abs(rates[0] - rates[1]) / rate
+    if spread > 0.10:
+        log(f"warning: CPU baseline runs differ by {spread:.1%}: {rates}")
     with open(CACHE, "w") as f:
-        json.dump({"samples_per_sec": rate, "batch": BATCH_CPU}, f)
+        json.dump(
+            {
+                "samples_per_sec": rate,
+                "batch": BATCH_CPU,
+                "steps": CPU_STEPS,
+                "runs": rates,
+                "rel_spread": round(spread, 4),
+            },
+            f,
+        )
     return rate
 
 

@@ -148,14 +148,28 @@ class TpuModel:
         validation_data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
         callbacks=(),
         stream_batches: Optional[int] = None,
+        initial_state=None,
     ) -> Dict[str, List[float]]:
         """Train on a ShardedDataset (or ``(x, y)``), reference §3.1/§3.2.
 
         ``stream_batches`` (sync mode): cap HBM residency at ~2×N global
         batches with a double-buffered host→device pipeline — for
         datasets larger than device memory.
+
+        ``initial_state``: a restored ``TrainState`` (e.g. from
+        ``elephas_tpu.checkpoint.CheckpointManager.restore``) to resume
+        from. Sync mode resumes weights, optimizer slots, and step;
+        async/hogwild seed the parameter server with the restored
+        weights/stats (workers re-init local optimizers — Downpour never
+        shares optimizer slots, SURVEY.md §3.2).
         """
         batch_size = batch_size or self.batch_size
+        if initial_state is not None:
+            # Fold restored weights into the master so every mode (and the
+            # PS store, which reads compiled.params) starts from them.
+            self._master.params = jax.device_get(initial_state.params)
+            self._master.batch_stats = jax.device_get(initial_state.batch_stats)
+            self._state = initial_state
         dataset = self._as_dataset(rdd, batch_size)
         if dataset.labels is None:
             raise ValueError("fit needs labels")
@@ -183,13 +197,17 @@ class TpuModel:
                 verbose=verbose,
                 callbacks=callbacks,
                 stream_batches=stream_batches,
+                initial_state=initial_state,
             )
             self._sync_trainer = trainer
         else:
             if stream_batches is not None:
                 raise ValueError(
-                    "stream_batches applies to mode='synchronous' (async "
-                    "workers already stream per-partition)"
+                    "stream_batches applies to mode='synchronous'; async/"
+                    "hogwild workers hold their partition device-resident "
+                    "(uploaded once, shuffled on device) — for datasets "
+                    "beyond per-chip HBM use mode='synchronous' with "
+                    "stream_batches, or more workers/partitions"
                 )
             from elephas_tpu.engine.async_engine import AsyncTrainer
 
@@ -208,6 +226,9 @@ class TpuModel:
                 validation_data=validation_data,
                 verbose=verbose,
                 callbacks=callbacks,
+                initial_step=(
+                    int(initial_state.step) if initial_state is not None else 0
+                ),
             )
             self._sync_trainer = None
 

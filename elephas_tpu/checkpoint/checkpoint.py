@@ -16,14 +16,33 @@ from elephas_tpu.engine.state import TrainState
 
 
 class CheckpointManager:
-    """Rotating snapshot manager + fit-callback factory."""
+    """Rotating snapshot manager + fit-callback factory.
 
-    def __init__(self, directory: str, keep: int = 3, save_every_epochs: int = 1):
+    ``host0_only``: restrict Orbax to process 0 (non-collective saves).
+    REQUIRED for multi-host async/hogwild fits, where epoch barriers are
+    host-local and only host 0 fires callbacks — a default (collective)
+    save from host 0 alone would block forever at Orbax's global sync
+    waiting for peers that never call save. Sync-mode multi-host fits
+    fire callbacks on every host and should keep the collective default.
+    """
+
+    def __init__(self, directory: str, keep: int = 3, save_every_epochs: int = 1,
+                 host0_only: bool = False):
+        import jax
+
         self.directory = os.path.abspath(directory)
         self.save_every = max(1, save_every_epochs)
+        self.host0_only = host0_only
+        extra = {}
+        if host0_only and jax.process_count() > 1:
+            extra["multiprocessing_options"] = ocp.options.MultiprocessingOptions(
+                primary_host=0, active_processes={0}
+            )
         self._manager = ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True, **extra
+            ),
         )
 
     def save(self, state: TrainState, step: Optional[int] = None, block: bool = False) -> None:

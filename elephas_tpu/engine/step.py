@@ -98,6 +98,24 @@ def make_eval_step(compiled) -> Callable:
     return eval_step
 
 
+def weighted_mean_over_chunks(spans, eval_chunk, n: int) -> Dict[str, float]:
+    """Exact weighted mean of per-chunk metric dicts over ``n`` rows.
+
+    ``spans`` yields tuples whose first two elements are (start, stop);
+    ``eval_chunk(*span)`` returns a metrics dict for those rows. Shared
+    by the sync sharded evaluator and the async host-local evaluator so
+    the weighting/remainder arithmetic cannot diverge between them
+    (both implement the reference's weighted-average evaluate, §3.5).
+    """
+    totals: Dict[str, float] = {}
+    for span in spans:
+        start, stop = span[0], span[1]
+        metrics = eval_chunk(*span)
+        for k, v in metrics.items():
+            totals[k] = totals.get(k, 0.0) + float(v) * (stop - start)
+    return {k: v / n for k, v in totals.items()}
+
+
 def make_predict_step(compiled) -> Callable:
     def predict_step(state: TrainState, x):
         return compiled.apply_eval(state.params, state.batch_stats, x)

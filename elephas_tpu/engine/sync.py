@@ -36,6 +36,7 @@ from elephas_tpu.engine.step import (
     make_eval_step,
     make_predict_step,
     make_train_step,
+    weighted_mean_over_chunks,
 )
 from elephas_tpu.parallel.mesh import DATA_AXIS, replicated_sharding
 
@@ -199,7 +200,13 @@ class SyncTrainer:
             state, metrics = self._epoch_fn(state, xs, ys, jnp.int32(epoch))
             metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
             if validation_data is not None:
-                val = self.evaluate_state(state, *validation_data, batch_size=batch_size)
+                # Eval in chunks of >=512 regardless of the (often tiny)
+                # training batch: each chunk is a host->device round-trip,
+                # and on a remote-tunneled chip the RTT of 64 tiny chunks
+                # dwarfs the eval compute. Weighted mean is exact either way.
+                val = self.evaluate_state(
+                    state, *validation_data, batch_size=max(batch_size, 512)
+                )
                 metrics.update({f"val_{k}": v for k, v in val.items()})
             for key, value in metrics.items():
                 history.setdefault(key, []).append(value)
@@ -385,7 +392,9 @@ class SyncTrainer:
             }
             if validation_data is not None:
                 snap = jax.tree_util.tree_map(lambda a: a[0], jax.device_get(state_block))
-                val = self.evaluate_state(snap, *validation_data, batch_size=batch_size)
+                val = self.evaluate_state(
+                    snap, *validation_data, batch_size=max(batch_size, 512)
+                )
                 metrics.update({f"val_{k}": v for k, v in val.items()})
             for key, value in metrics.items():
                 history.setdefault(key, []).append(value)
@@ -481,17 +490,18 @@ class SyncTrainer:
         weighted mean over ALL rows (ragged remainder evaluated on one
         device, matching the reference's weighted-average evaluate)."""
         eval_fn = self._eval_fn
-        totals: Dict[str, float] = {}
         n = len(features)
-        for start, stop, sharded in self._global_chunks(n, batch_size):
+
+        def eval_chunk(start, stop, sharded):
             if sharded:
                 x, y = _put_batch(self.mesh, features[start:stop], labels[start:stop])
             else:
                 x, y = jnp.asarray(features[start:stop]), jnp.asarray(labels[start:stop])
-            metrics = jax.device_get(eval_fn(state, x, y))
-            for k, v in metrics.items():
-                totals[k] = totals.get(k, 0.0) + float(v) * (stop - start)
-        return {k: v / n for k, v in totals.items()}
+            return jax.device_get(eval_fn(state, x, y))
+
+        return weighted_mean_over_chunks(
+            self._global_chunks(n, batch_size), eval_chunk, n
+        )
 
     def predict_state(self, state, features, batch_size: int = 256) -> np.ndarray:
         predict_fn = self._predict_fn

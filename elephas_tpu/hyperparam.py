@@ -28,7 +28,21 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
-__all__ = ["hp", "HyperParamModel", "sample_space"]
+__all__ = ["hp", "HyperParamModel", "sample_space", "current_trial_device"]
+
+_trial_ctx = threading.local()
+
+
+def current_trial_device():
+    """The device the calling trial's worker thread is pinned to.
+
+    For use inside objectives that build their own mesh/trainer (e.g.
+    the parity harness): each worker thread publishes its device here
+    before running trials. Outside a trial thread, falls back to the
+    default device.
+    """
+    device = getattr(_trial_ctx, "device", None)
+    return device if device is not None else jax.devices()[0]
 
 
 class _Dist:
@@ -285,6 +299,7 @@ class HyperParamModel:
             # pairs, unlike arithmetic seed mixing.
             rng = np.random.default_rng([seed, index])
             sampler = _SAMPLERS[algo](space, rng)
+            _trial_ctx.device = device  # thread-local; see current_trial_device
             try:
                 with jax.default_device(device):
                     for trial in range(trials_for[index]):

@@ -69,6 +69,11 @@ class ElephasEstimator(
     """
 
     def __init__(self, **kwargs):
+        # Runtime-only fit callbacks ((epoch, state, metrics) callables) —
+        # deliberately NOT a persisted Param: callables don't serialize
+        # into a pipeline stage. Used by the parity harness for epoch
+        # timestamps and by users for checkpointing during estimator fits.
+        self.callbacks = tuple(kwargs.pop("callbacks", ()))
         self.set_params(**kwargs)
 
     def _build_model(self):
@@ -133,6 +138,7 @@ class ElephasEstimator(
             batch_size=self.batch_size,
             verbose=self.verbose,
             validation_split=self.validation_split,
+            callbacks=getattr(self, "callbacks", ()),
         )
         return ElephasTransformer(
             model_payload=model_to_dict(spark_model.master_network),

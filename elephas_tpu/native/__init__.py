@@ -1,8 +1,9 @@
 """Native (C++) host ops with lazy compilation and numpy fallback.
 
-``gather_rows(x, y, perm)`` is the epoch-shuffle gather used by the async
-engine and ``ShardedDataset.shuffle``: a threaded row-copy that fuses the
-features and labels passes. Built on first use with ``g++ -O3 -shared``
+``gather_rows(x, y, perm)`` is the host-side shuffle gather used by the
+streaming sync path and ``ShardedDataset.shuffle`` (async workers now
+shuffle on device — see ``engine/async_engine.py``): a threaded row-copy
+that fuses the features and labels passes. Built on first use with ``g++ -O3 -shared``
 (toolchain is baked into the image; no pip/pybind needed — ctypes ABI).
 Every entry point falls back to numpy when the toolchain or the build is
 unavailable, so the framework never hard-depends on the native path.

@@ -11,16 +11,23 @@ hanging — connection-level failures are retried with exponential backoff
 for a small budget (~3s), then raised as ``ParameterServerUnavailable``
 naming the address, so a dead PS surfaces as an actionable error within
 seconds rather than a 60s socket stall per call.
+
+Idempotency & wedge handling: only connection ESTABLISHMENT is retried
+(plus, for socket reads, one transparent reconnect of a pooled
+connection that died between calls). Once a request has left the
+socket, failures raise immediately — a re-sent write would double-apply
+a delta or double-count a teardown-barrier arrival (tearing the PS down
+under a peer mid-pull), and retrying a read timeout on an established
+connection would stall ``timeout``-per-attempt against a wedged server.
 """
 
 from __future__ import annotations
 
+import http.client
 import pickle
 import socket
 import threading
 import time
-import urllib.error
-import urllib.request
 
 import jax
 
@@ -42,15 +49,16 @@ def _retry_connect(fn, address: str, op: str):
 
     Anything that indicates the server is *gone* (refused, reset, DNS,
     dial timeout) is retried then converted to ParameterServerUnavailable;
-    application-level errors (HTTP 4xx/5xx) propagate immediately.
+    application-level errors (HTTP 4xx/5xx → RuntimeError) propagate
+    immediately. Callers must only pass an ``fn`` that is safe to run
+    again (a pure read, or connection establishment) — see the module
+    docstring's idempotency contract.
     """
     last: Exception | None = None
     for delay in (*_RETRY_DELAYS, None):
         try:
             return fn()
-        except urllib.error.HTTPError:
-            raise  # server alive, request bad — not a connectivity issue
-        except (ConnectionError, socket.timeout, TimeoutError, OSError, urllib.error.URLError) as exc:
+        except (ConnectionError, socket.timeout, TimeoutError, OSError) as exc:
             last = exc
         if delay is None:
             break
@@ -104,73 +112,91 @@ class _WireBarrierMixin:
 
 
 class HttpClient(_WireBarrierMixin, BaseParameterClient):
-    """urllib against ``GET /parameters`` / ``POST /update``.
+    """``http.client`` against ``GET /parameters`` / ``POST /update``.
 
-    ``timeout`` bounds the transfer once connected; dialing a dead/absent
-    server fails within ``_CONNECT_TIMEOUT`` per attempt and is retried by
-    ``_retry_connect`` (fail-fast, see module docstring).
+    Dialing gets ``_CONNECT_TIMEOUT`` per attempt (a blackholed host
+    fails in ~2s, not ``timeout``); the socket is then re-budgeted to
+    ``timeout`` for the transfer. Only the dial retries — see the
+    module docstring's idempotency/wedge contract.
     """
 
     def __init__(self, master_url: str, timeout: float = 60.0):
+        host, port = master_url.rsplit(":", 1)
         self.master_url = master_url
+        self._addr = (host, int(port))
         self.timeout = timeout
 
-    def _url(self, path: str) -> str:
-        return f"http://{self.master_url}{path}"
+    def _connect_once(self) -> http.client.HTTPConnection:
+        conn = http.client.HTTPConnection(*self._addr, timeout=_CONNECT_TIMEOUT)
+        conn.connect()  # fail the dial fast; transfers get the long budget
+        conn.sock.settimeout(self.timeout)
+        return conn
+
+    @staticmethod
+    def _roundtrip(conn, method: str, path: str, payload) -> bytes:
+        try:
+            headers = {"Content-Type": "application/octet-stream"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status >= 400:
+                raise RuntimeError(
+                    f"parameter server returned HTTP {resp.status} for {path}"
+                )
+            return body
+        finally:
+            conn.close()
+
+    def _call(self, method: str, path: str, payload, op: str) -> bytes:
+        """Dial with the retry budget, then ONE transfer attempt.
+
+        Only the dial phase retries: a refused/blackholed host is the
+        transient case worth ~3s of patience. Once connected, a transfer
+        failure means the server is wedged (accepting but not serving) or
+        died mid-request — retrying would stall ``timeout``-per-attempt
+        (and for writes, risk double-apply), so it raises immediately.
+        """
+        conn = _retry_connect(self._connect_once, self.master_url, op)
+        try:
+            return self._roundtrip(conn, method, path, payload)
+        # HTTPException covers a server that closes mid-response (e.g.
+        # BadStatusLine/RemoteDisconnected during PS shutdown).
+        except (ConnectionError, socket.timeout, TimeoutError, OSError,
+                http.client.HTTPException) as exc:
+            raise ParameterServerUnavailable(
+                f"parameter server at {self.master_url} failed after the {op} "
+                f"request was sent (transfer not retried — server wedged or "
+                f"died mid-request): {exc}"
+            ) from exc
+
+    def _get(self, path: str, op: str) -> bytes:
+        return self._call("GET", path, None, op)
+
+    def _post(self, path: str, payload: bytes, op: str) -> bytes:
+        return self._call("POST", path, payload, op)
 
     def get_parameters(self):
-        def attempt():
-            with urllib.request.urlopen(
-                self._url("/parameters"), timeout=self.timeout
-            ) as resp:
-                return pickle.loads(resp.read())
-
-        return _retry_connect(attempt, self.master_url, "get_parameters")
+        return pickle.loads(self._get("/parameters", "get_parameters"))
 
     def update_parameters(self, delta) -> None:
         delta = jax.device_get(delta)
         payload = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)
-
-        def attempt():
-            req = urllib.request.Request(
-                self._url("/update"),
-                data=payload,
-                headers={"Content-Type": "application/octet-stream"},
-                method="POST",
-            )
-            with urllib.request.urlopen(req, timeout=self.timeout):
-                pass
-
-        _retry_connect(attempt, self.master_url, "update_parameters")
+        self._post("/update", payload, "update_parameters")
 
     def health(self) -> bool:
         """One non-retried probe of ``GET /health`` (liveness check)."""
         try:
-            with urllib.request.urlopen(
-                self._url("/health"), timeout=_CONNECT_TIMEOUT
-            ) as resp:
-                return resp.status == 200
+            return self._roundtrip(
+                self._connect_once(), "GET", "/health", None
+            ) == b"ok"
         except Exception:
             return False
 
     def barrier_arrive(self, tag: str) -> int:
-        def attempt():
-            req = urllib.request.Request(
-                self._url(f"/barrier/{tag}"), data=b"", method="POST"
-            )
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return int(resp.read())
-
-        return _retry_connect(attempt, self.master_url, "barrier_arrive")
+        return int(self._post(f"/barrier/{tag}", b"", "barrier_arrive"))
 
     def barrier_count(self, tag: str) -> int:
-        def attempt():
-            with urllib.request.urlopen(
-                self._url(f"/barrier/{tag}"), timeout=self.timeout
-            ) as resp:
-                return int(resp.read())
-
-        return _retry_connect(attempt, self.master_url, "barrier_count")
+        return int(self._get(f"/barrier/{tag}", "barrier_count"))
 
 
 def make_client(mode: str, address: str) -> BaseParameterClient:
@@ -208,51 +234,84 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
             self._sock = _retry_connect(attempt, self.master_url, "connect")
         return self._sock
 
-    def _roundtrip(self, frame, op: str):
-        """Send one frame, read one reply; a connection that died between
-        calls (PS restart) gets ONE reconnect, then fails fast."""
-        for retry in (True, False):
+    def _roundtrip(self, frame, op: str, idempotent: bool):
+        """Send one frame, read one reply.
+
+        ``idempotent`` round-trips (reads) get ONE transparent
+        reconnect-and-resend if the pooled connection died between calls
+        (PS restart); writes are never re-sent after a send attempt — the
+        server may already have applied them (module docstring).
+        """
+        for retry in (idempotent, False):
             sock = self._connection()
             try:
                 socket_utils.send(sock, frame)
                 return socket_utils.receive(sock)
-            except (ConnectionError, socket.timeout, TimeoutError, OSError) as exc:
-                self._sock = None
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+            except (socket.timeout, TimeoutError) as exc:
+                # Read timeout on an ESTABLISHED connection: the server is
+                # wedged, not restarting — another ``timeout``-long attempt
+                # would stall, so fail fast (module docstring contract).
+                self._drop_connection(sock)
+                raise ParameterServerUnavailable(
+                    f"parameter server at {self.master_url} timed out during "
+                    f"{op} (wedged — not retried): {exc}"
+                ) from exc
+            except (ConnectionError, OSError) as exc:
+                # Reset/EPIPE: a pooled connection died between calls (PS
+                # restart). Reads get one transparent reconnect; writes
+                # don't — the server may already have applied them.
+                self._drop_connection(sock)
                 if not retry:
                     raise ParameterServerUnavailable(
                         f"parameter server at {self.master_url} dropped the "
-                        f"connection during {op}: {exc}"
+                        f"connection during {op}"
+                        + ("" if idempotent else " (write not re-sent: a "
+                           "duplicate would double-apply)")
+                        + f": {exc}"
                     ) from exc
+
+    def _drop_connection(self, sock) -> None:
+        self._sock = None
+        try:
+            sock.close()
+        except OSError:
+            pass
 
     def get_parameters(self):
         with self._lock:
-            return self._roundtrip(("g", None), "get_parameters")
+            return self._roundtrip(("g", None), "get_parameters", idempotent=True)
 
     def update_parameters(self, delta) -> None:
         delta = jax.device_get(delta)
         with self._lock:
-            self._roundtrip(("u", delta), "update_parameters")
+            self._roundtrip(("u", delta), "update_parameters", idempotent=False)
 
     def health(self) -> bool:
-        """Liveness probe: a barrier *count* is read-only and cheap."""
+        """Liveness probe: a barrier *count* on a FRESH connection.
+
+        A stopped server keeps serving already-accepted connections until
+        they close, so probing the pooled one would report a dead PS
+        alive; dialing anew answers "would a new worker get in?".
+        """
         try:
-            with self._lock:
-                self._roundtrip(("c", "health"), "health")
+            sock = socket.create_connection(self._addr, timeout=_CONNECT_TIMEOUT)
+            try:
+                sock.settimeout(_CONNECT_TIMEOUT)
+                socket_utils.send(sock, ("c", "health"))
+                socket_utils.receive(sock)
+            finally:
+                sock.close()
             return True
         except Exception:
             return False
 
     def barrier_arrive(self, tag: str) -> int:
         with self._lock:
-            return self._roundtrip(("b", tag), "barrier_arrive")
+            return self._roundtrip(("b", tag), "barrier_arrive", idempotent=False)
 
     def barrier_count(self, tag: str) -> int:
         with self._lock:
-            return self._roundtrip(("c", tag), "barrier_count")
+            return self._roundtrip(("c", tag), "barrier_count", idempotent=True)
 
     def close(self) -> None:
         with self._lock:

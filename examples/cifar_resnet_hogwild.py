@@ -42,7 +42,8 @@ def main():
     print("final:", {k: round(v[-1], 4) for k, v in history.items()}, "real_data:", real)
 
     val_acc = history["val_acc"][-1]
-    assert val_acc > 0.4, f"CIFAR ResNet hogwild regressed: val_acc={val_acc:.3f} <= 0.4"
+    # Label-noise-capped synthetic (~0.89 Bayes); 3-epoch runs land ~0.8.
+    assert val_acc > 0.6, f"CIFAR ResNet hogwild regressed: val_acc={val_acc:.3f} <= 0.6"
 
 
 if __name__ == "__main__":

@@ -29,17 +29,38 @@ import time
 import numpy as np
 
 
-def _throughput(n_rows: int, epochs: int, seconds: float) -> float:
-    return round(n_rows * epochs / seconds, 2)
+class EpochTimer:
+    """Fit callback recording a wall-clock timestamp at every epoch end.
+
+    Steady-state throughput comes from epochs 2..N (the span between the
+    first and last timestamp): epoch 1 pays jit compilation, so dividing
+    total rows by total wall time understates the framework's real rate
+    by orders of magnitude on short runs (VERDICT r2 weak #1).
+    """
+
+    def __init__(self):
+        self.times = []
+
+    def __call__(self, epoch, state, metrics):
+        self.times.append(time.perf_counter())
 
 
-def _record(name, mode, history, n_rows, epochs, secs, real, extra=None):
+def _record(name, mode, history, n_rows, epochs, secs, real, timer=None, extra=None):
     val_keys = [k for k in history if k.startswith("val_") and "acc" in k]
     acc_keys = [k for k in history if "acc" in k and not k.startswith("val_")]
+    if timer is not None and len(timer.times) >= 2:
+        span = timer.times[-1] - timer.times[0]
+        rate = n_rows * (len(timer.times) - 1) / span
+        timing = "steady_state"  # excludes epoch 1 (compile + warmup)
+    else:
+        rate = n_rows * epochs / secs
+        timing = "total_incl_compile"
     rec = {
         "config": name,
         "mode": mode,
-        "samples_per_sec": _throughput(n_rows, epochs, secs),
+        "samples_per_sec": round(rate, 2),
+        "timing": timing,
+        "total_secs": round(secs, 2),
         "final_val_acc": round(float(history[val_keys[0]][-1]), 4) if val_keys else None,
         "final_train_acc": round(float(history[acc_keys[0]][-1]), 4) if acc_keys else None,
         "real_data": real,
@@ -77,13 +98,15 @@ def mnist_mlp_sync(quick: bool):
     )
     epochs = 2 if quick else 5
     model = SparkModel(net, mode="synchronous", frequency="epoch", num_workers=4)
+    timer = EpochTimer()
     t0 = time.perf_counter()
     history = model.fit(
         to_simple_rdd(None, x, y, 4), epochs=epochs, batch_size=32,
-        validation_data=(xv, yv),
+        validation_data=(xv, yv), callbacks=[timer],
     )
     secs = time.perf_counter() - t0
-    return _record("mnist_mlp_sync", "synchronous", history, len(x), epochs, secs, real)
+    return _record("mnist_mlp_sync", "synchronous", history, len(x), epochs, secs,
+                   real, timer)
 
 
 def mnist_cnn_async(quick: bool):
@@ -107,18 +130,20 @@ def mnist_cnn_async(quick: bool):
         metrics=["acc"],
         input_shape=x.shape[1:],
     )
-    epochs = 1 if quick else 3
+    epochs = 2 if quick else 3
     import jax
 
     n_workers = len(jax.devices())
     model = SparkModel(net, mode="asynchronous", frequency="epoch", num_workers=n_workers)
+    timer = EpochTimer()
     t0 = time.perf_counter()
     history = model.fit(
         to_simple_rdd(None, x, y, n_workers), epochs=epochs, batch_size=64,
-        validation_data=(xv, yv),
+        validation_data=(xv, yv), callbacks=[timer],
     )
     secs = time.perf_counter() - t0
-    return _record("mnist_cnn_async", "asynchronous", history, len(x), epochs, secs, real)
+    return _record("mnist_cnn_async", "asynchronous", history, len(x), epochs, secs,
+                   real, timer)
 
 
 def imdb_lstm_estimator(quick: bool):
@@ -133,11 +158,13 @@ def imdb_lstm_estimator(quick: bool):
         xtr, ytr = xtr[:2048], ytr[:2048]
         xte, yte = xte[:512], yte[:512]
     df = to_data_frame(None, xtr.astype(np.float32), ytr.astype(np.float32))
-    epochs = 1 if quick else 2
+    epochs = 2 if quick else 3
     import jax
 
     n_workers = len(jax.devices())
+    timer = EpochTimer()
     est = ElephasEstimator(
+        callbacks=[timer],
         keras_model_config={
             "name": "lstm",
             "kwargs": {
@@ -168,7 +195,8 @@ def imdb_lstm_estimator(quick: bool):
     val_acc = float((preds.argmax(-1) == yte).mean())
     history = {"val_acc": [val_acc]}
     return _record(
-        "imdb_lstm_estimator", "estimator", history, len(xtr), epochs, secs, real
+        "imdb_lstm_estimator", "estimator", history, len(xtr), epochs, secs, real,
+        timer,
     )
 
 
@@ -188,26 +216,32 @@ def cifar10_resnet18_hogwild(quick: bool):
     y = one_hot(ytr, 10)
     xv = (xte.astype(np.float32) - mean) / std
     yv = one_hot(yte, 10)
+    import jax
+
+    # bf16 compute/norm-output on TPU (the framework's native config —
+    # PROFILE.md §1; f32 stats per flax semantics), f32 on CPU CI.
+    dtype = "bfloat16" if jax.default_backend() == "tpu" else "float32"
     net = compile_model(
-        get_model("resnet18", num_classes=10, width=16 if quick else 64),
+        get_model("resnet18", num_classes=10, width=16 if quick else 64,
+                  dtype=dtype),
         optimizer={"name": "momentum", "learning_rate": 0.05},
         loss="categorical_crossentropy",
         metrics=["acc"],
         input_shape=x.shape[1:],
     )
-    epochs = 1 if quick else 3
-    import jax
-
+    epochs = 2 if quick else 4
     n_workers = len(jax.devices())
     model = SparkModel(net, mode="hogwild", frequency="epoch", num_workers=n_workers)
+    timer = EpochTimer()
     t0 = time.perf_counter()
     history = model.fit(
-        to_simple_rdd(None, x, y, n_workers), epochs=epochs, batch_size=128,
-        validation_data=(xv, yv),
+        to_simple_rdd(None, x, y, n_workers), epochs=epochs, batch_size=512,
+        validation_data=(xv, yv), callbacks=[timer],
     )
     secs = time.perf_counter() - t0
     return _record(
-        "cifar10_resnet18_hogwild", "hogwild", history, len(x), epochs, secs, real
+        "cifar10_resnet18_hogwild", "hogwild", history, len(x), epochs, secs, real,
+        timer,
     )
 
 
@@ -216,7 +250,7 @@ def hyperparam_search(quick: bool):
     from elephas_tpu import compile_model
     from elephas_tpu.data.datasets import load_mnist, one_hot
     from elephas_tpu.engine.sync import SyncTrainer
-    from elephas_tpu.hyperparam import HyperParamModel, hp
+    from elephas_tpu.hyperparam import HyperParamModel, current_trial_device, hp
     from elephas_tpu.models import get_model
     from elephas_tpu.data.rdd import ShardedDataset
     from elephas_tpu.parallel.mesh import build_mesh
@@ -237,18 +271,15 @@ def hyperparam_search(quick: bool):
             metrics=["acc"],
             input_shape=x.shape[1:],
         )
-        # respect the trial worker's pinned device (HyperParamModel sets
-        # jax.default_device per worker thread)
-        dev = jax.config.jax_default_device or jax.devices()[0]
-        mesh = build_mesh(num_data=1, devices=[dev])
+        # respect the trial worker's pinned device (published thread-local
+        # by HyperParamModel's worker threads)
+        mesh = build_mesh(num_data=1, devices=[current_trial_device()])
         trainer = SyncTrainer(net, mesh, frequency="batch")
         state, history = trainer.fit(
             ShardedDataset(x, y, 1), epochs=1 if quick else 2, batch_size=64
         )
         val = trainer.evaluate_state(state, xv, yv)
         return {"loss": float(val["loss"]), "val_acc": float(val["acc"])}
-
-    import jax
 
     model = HyperParamModel(None)
     max_evals = 2 if quick else 6

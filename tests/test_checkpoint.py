@@ -117,3 +117,37 @@ def test_async_checkpoints_advance_steps(tmp_path, blobs):
     steps = mgr._manager.all_steps()
     assert sorted(steps) == [1, 2, 3], steps
     mgr.close()
+
+
+def test_async_resume_checkpoint_steps_continue(tmp_path, blobs):
+    """Resuming an async fit from a restored state must keep snapshot
+    steps advancing past the restored step — Orbax no-ops on already-
+    saved steps, so reusing 1..E would silently drop every save."""
+    from elephas_tpu import SparkModel, to_simple_rdd
+    from elephas_tpu.api.compile import compile_model
+    from elephas_tpu.models import get_model
+
+    x, y = blobs
+
+    def build():
+        return compile_model(
+            get_model("mlp", features=(16,), num_classes=4),
+            optimizer={"name": "sgd", "learning_rate": 0.05},
+            loss="categorical_crossentropy",
+            metrics=["acc"],
+            input_shape=(x.shape[1],),
+            seed=0,
+        )
+
+    mgr = CheckpointManager(str(tmp_path), keep=10)
+    model = SparkModel(build(), mode="asynchronous", frequency="epoch", num_workers=2)
+    model.fit(to_simple_rdd(None, x, y, 2), epochs=2, batch_size=16,
+              callbacks=[mgr.callback()])
+    assert sorted(mgr._manager.all_steps()) == [1, 2]
+    restored = mgr.restore(init_train_state(build()))
+    assert int(restored.step) == 2
+    model2 = SparkModel(build(), mode="hogwild", frequency="epoch", num_workers=2)
+    model2.fit(to_simple_rdd(None, x, y, 2), epochs=2, batch_size=16,
+               callbacks=[mgr.callback()], initial_state=restored)
+    assert sorted(mgr._manager.all_steps()) == [1, 2, 3, 4]
+    mgr.close()

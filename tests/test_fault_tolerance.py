@@ -1,0 +1,212 @@
+"""Fault injection: kill-and-resume + parameter-server death (VERDICT r2 #2).
+
+The reference delegates fault tolerance wholesale to Spark (task retry,
+stage re-execution — SURVEY.md §5.3); on TPU pods that net does not
+exist, so the rebuild's contract is (a) periodic snapshots let a
+restarted job RESUME (not restart), proven here by SIGKILLing a real
+training process mid-epoch, and (b) a dead parameter server surfaces as
+an actionable error within seconds (clients fail fast — see
+``elephas_tpu/parameter/client.py``), not a per-call 60s stall.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import make_blobs
+
+_CHILD = """
+import json, os, sys
+phase, ckpt_dir = sys.argv[1], sys.argv[2]
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from elephas_tpu import SparkModel, compile_model, to_simple_rdd
+from elephas_tpu.checkpoint import CheckpointManager
+from elephas_tpu.engine.step import init_train_state
+from elephas_tpu.models import get_model
+
+rng = np.random.default_rng(0)
+dim, nc, n = 10, 3, 384
+centers = rng.normal(scale=2.5, size=(nc, dim))
+labels = rng.integers(0, nc, size=n)
+x = (centers[labels] + rng.normal(size=(n, dim))).astype(np.float32)
+y = np.eye(nc, dtype=np.float32)[labels]
+
+def build():
+    return compile_model(
+        get_model("mlp", features=(16,), num_classes=nc),
+        optimizer={"name": "sgd", "learning_rate": 0.05},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(dim,),
+        seed=7,
+    )
+
+mgr = CheckpointManager(ckpt_dir, keep=10)
+if phase == "train":
+    model = SparkModel(build(), mode="synchronous", frequency="epoch", num_workers=2)
+    def cb(epoch, state, metrics):
+        mgr.save(state, block=True)  # durable before the progress line
+        print("EPOCH", epoch, flush=True)
+    model.fit(to_simple_rdd(None, x, y, 2), epochs=50, batch_size=16, callbacks=[cb])
+    print("FINISHED", flush=True)  # parent kills us long before 50 epochs
+else:  # phase == "resume"
+    restored = mgr.restore(init_train_state(build()))
+    model = SparkModel(build(), mode="synchronous", frequency="epoch", num_workers=2)
+    resumed = model.fit(to_simple_rdd(None, x, y, 2), epochs=1, batch_size=16,
+                        initial_state=restored)
+    fresh = SparkModel(build(), mode="synchronous", frequency="epoch", num_workers=2)
+    fresh_hist = fresh.fit(to_simple_rdd(None, x, y, 2), epochs=1, batch_size=16)
+    print("RESUME " + json.dumps({
+        "restored_step": int(restored.step),
+        "resumed_loss": resumed["loss"][0],
+        "fresh_loss": fresh_hist["loss"][0],
+    }), flush=True)
+"""
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_sigkill_and_resume_continues_trajectory(tmp_path):
+    """SIGKILL a training process after a few durable snapshots; a restarted
+    process restores the latest one and CONTINUES (its next-epoch loss beats
+    a fresh run's first-epoch loss) rather than restarting from scratch."""
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    ckpt_dir = str(tmp_path / "ckpts")
+
+    proc = subprocess.Popen(
+        [sys.executable, str(script), "train", ckpt_dir],
+        env=_child_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    killed = False
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("EPOCH 2"):  # ≥3 durable snapshots exist
+            os.kill(proc.pid, signal.SIGKILL)
+            killed = True
+            break
+    assert killed, "never saw EPOCH 2 before timeout/exit"
+    proc.wait(timeout=60)
+    assert proc.returncode == -signal.SIGKILL
+
+    out = subprocess.run(
+        [sys.executable, str(script), "resume", ckpt_dir],
+        env=_child_env(), capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, f"resume failed:\n{out.stdout}\n{out.stderr[-3000:]}"
+    rec = next(
+        json.loads(l[len("RESUME "):]) for l in out.stdout.splitlines()
+        if l.startswith("RESUME ")
+    )
+    # The snapshot carries real progress (sync fit advances step per batch)...
+    assert rec["restored_step"] > 0
+    # ...and resuming continues the trajectory: one more epoch from the
+    # snapshot lands clearly below a fresh run's first epoch.
+    assert rec["resumed_loss"] < rec["fresh_loss"] * 0.9, rec
+
+
+def test_health_probe_reflects_server_liveness():
+    """``/health`` (http) and the read-only barrier probe (socket) return
+    True while the PS is up and False within ~2s once it is stopped."""
+    import numpy as np
+
+    from elephas_tpu.parameter.server import HttpServer, SocketServer
+
+    params = {"w": np.zeros(4, dtype=np.float32)}
+    for cls in (HttpServer, SocketServer):
+        server = cls(params, lock=True, port=0, host="127.0.0.1")
+        server.start()
+        client = server.client()
+        assert client.health() is True, cls.__name__
+        server.stop()
+        t0 = time.monotonic()
+        alive = client.health()
+        assert alive is False, cls.__name__
+        assert time.monotonic() - t0 < 5, "health probe must not stall"
+        if hasattr(client, "close"):
+            client.close()
+
+
+def test_ps_death_mid_async_fit_fails_fast(monkeypatch):
+    """Stop the parameter server mid-async-fit: every worker's next wire op
+    must raise ``ParameterServerUnavailable`` after its short retry budget,
+    and ``fit`` must re-raise it promptly (seconds, not 60s-per-call)."""
+    from elephas_tpu import SparkModel, compile_model, to_simple_rdd
+    from elephas_tpu.engine import async_engine
+    from elephas_tpu.models import get_model
+    from elephas_tpu.parameter.client import ParameterServerUnavailable
+    from elephas_tpu.parameter.server import make_server as real_make_server
+
+    captured = []
+
+    def capturing_make_server(*args, **kwargs):
+        server = real_make_server(*args, **kwargs)
+        captured.append(server)
+        return server
+
+    monkeypatch.setattr(async_engine, "make_server", capturing_make_server)
+
+    x, y = make_blobs(n=256, num_classes=3, dim=8, seed=11)
+    net = compile_model(
+        get_model("mlp", features=(16,), num_classes=3),
+        optimizer={"name": "sgd", "learning_rate": 0.05},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(8,),
+        seed=0,
+    )
+    model = SparkModel(
+        net, mode="asynchronous", frequency="batch",
+        parameter_server_mode="http", num_workers=2, port=0,
+    )
+    errors = []
+
+    def run_fit():
+        t0 = time.monotonic()
+        try:
+            model.fit(to_simple_rdd(None, x, y, 2), epochs=5000, batch_size=16)
+            errors.append(("finished", time.monotonic() - t0))
+        except Exception as exc:  # noqa: BLE001 — recorded for the main thread
+            errors.append((exc, time.monotonic()))
+
+    fit_thread = threading.Thread(target=run_fit, daemon=True)
+    fit_thread.start()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if captured and captured[0].buffer.version >= 5:  # training underway
+            break
+        time.sleep(0.05)
+    assert captured and captured[0].buffer.version >= 5, "fit never got going"
+
+    stop_time = time.monotonic()
+    captured[0].stop()
+    fit_thread.join(timeout=60)
+    assert not fit_thread.is_alive(), "fit hung after PS death"
+    assert errors, "fit returned nothing"
+    exc, when = errors[0]
+    assert isinstance(exc, ParameterServerUnavailable), exc
+    # Actionable: names the PS address. Message varies with where the
+    # death lands ("unreachable" on a fresh dial vs "failed after the
+    # ... request was sent" when it races an in-flight round-trip).
+    assert model.parameter_server_mode == "http" and "127.0.0.1" in str(exc)
+    # Fail-fast bound: retry budget (~2.8s sleep + dial timeouts) plus
+    # thread teardown — far below the old 60s-per-call stall.
+    assert when - stop_time < 25, f"took {when - stop_time:.1f}s to surface"

@@ -1,0 +1,71 @@
+"""Hogwild lost-update measurement (VERDICT r2 #6).
+
+``ParameterBuffer(lock=False)`` races whole-pytree read-modify-writes:
+an ``apply_delta`` that reads weights W can be overwritten by a
+concurrent apply that also read W — the entire delta vanishes. That is
+COARSER than Hogwild!'s per-coordinate races (the reference's lock-free
+server mutates one shared weight list in place, losing at most
+per-element increments). This test measures the applied-update fraction
+under deliberate 8-thread contention so the memory-model note in
+``elephas_tpu/parameter/buffer.py`` carries a number, and pins the two
+contracts: locked mode applies EVERY update; hogwild applies a nonzero
+fraction and never corrupts values (every survivor is an exact integer
+sum of whole deltas).
+"""
+
+import threading
+
+import jax
+import numpy as np
+
+from elephas_tpu.parameter.buffer import ParameterBuffer
+
+N_THREADS = 8
+N_UPDATES = 150  # per thread; integer-valued f32 stays exact far past this
+
+
+def _hammer(buffer: ParameterBuffer) -> None:
+    delta = {"w": -np.ones(8, dtype=np.float32)}  # apply is W -= delta → +1
+    barrier = threading.Barrier(N_THREADS)
+
+    def worker():
+        barrier.wait()  # maximize overlap
+        for _ in range(N_UPDATES):
+            buffer.apply_delta(delta)
+
+    threads = [threading.Thread(target=worker) for _ in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def test_locked_buffer_applies_every_update():
+    buffer = ParameterBuffer({"w": np.zeros(8, dtype=np.float32)}, lock=True)
+    _hammer(buffer)
+    total = N_THREADS * N_UPDATES
+    applied = float(np.asarray(jax.device_get(buffer.get())["w"])[0])
+    assert applied == total, f"locked mode lost {total - applied} updates"
+    assert buffer.version == total
+
+
+def test_hogwild_lost_update_rate_measured():
+    buffer = ParameterBuffer({"w": np.zeros(8, dtype=np.float32)}, lock=False)
+    _hammer(buffer)
+    total = N_THREADS * N_UPDATES
+    w = np.asarray(jax.device_get(buffer.get())["w"])
+    # No torn/corrupt values: every element saw the same whole-delta sum.
+    assert np.all(w == w[0]), w
+    applied = float(w[0])
+    assert applied == int(applied), "non-integer sum ⇒ torn update"
+    fraction = applied / total
+    # The version counter counts ATTEMPTS (it has its own guard), so the
+    # lost-update rate is directly observable as 1 - fraction.
+    assert buffer.version == total
+    # Contract bounds: progress is guaranteed (some updates always land);
+    # losing updates is permitted (that's hogwild), so the fraction lives
+    # in (0, 1]. Measured on this CI harness (8 threads, jitted CPU
+    # apply): typically ~0.3–0.9 — recorded in buffer.py's note.
+    assert 0.0 < fraction <= 1.0
+    print(f"hogwild applied-update fraction: {fraction:.3f} "
+          f"({int(applied)}/{total})")

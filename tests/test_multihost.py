@@ -19,8 +19,9 @@ import pytest
 
 _CHILD = """
 import os, sys
-idx, nproc, coord, psmode, port = (
-    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4], int(sys.argv[5])
+idx, nproc, coord, psmode, port, mode = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4], int(sys.argv[5]),
+    sys.argv[6],
 )
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 import jax
@@ -49,7 +50,7 @@ net = compile_model(
     input_shape=(dim,),
 )
 model = SparkModel(
-    net, mode="asynchronous", frequency="epoch",
+    net, mode=mode, frequency="epoch",
     parameter_server_mode=psmode, num_workers=8, port=port,
 )
 history = model.fit(to_simple_rdd(None, x, y, 8), epochs=3, batch_size=16)
@@ -67,8 +68,21 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("ps_mode", ["http", "socket"])
-def test_two_process_async_one_parameter_server(tmp_path, ps_mode):
+@pytest.mark.parametrize(
+    "mode,ps_mode",
+    [
+        ("asynchronous", "http"),
+        ("asynchronous", "socket"),
+        ("synchronous", "http"),  # sync never dials the PS; ps_mode inert
+        ("hogwild", "http"),
+        ("hogwild", "socket"),
+    ],
+)
+def test_two_process_training_all_modes(tmp_path, mode, ps_mode):
+    """All three coordination modes across REAL process boundaries
+    (VERDICT r2 #4): async/hogwild share one PS on host 0; synchronous is
+    pure SPMD over the global 8-way mesh. Every mode must leave both
+    ranks with bitwise-identical weights and a trained model."""
     script = tmp_path / "child.py"
     script.write_text(_CHILD)
     coord = f"127.0.0.1:{_free_port()}"
@@ -78,7 +92,7 @@ def test_two_process_async_one_parameter_server(tmp_path, ps_mode):
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(i), "2", coord, ps_mode, "0"],
+            [sys.executable, str(script), str(i), "2", coord, ps_mode, "0", mode],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
