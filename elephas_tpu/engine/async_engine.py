@@ -90,21 +90,23 @@ class AsyncTrainer:
         global-mesh SPMD evaluate can't run (host-0 epoch barriers in
         multi-host async are local, so a collective would desync peers)."""
         if self._local_eval_fn is None:
-            from elephas_tpu.engine.step import make_eval_step
+            from elephas_tpu.engine.step import DeviceEvalCache, make_eval_step
 
             self._local_eval_fn = jax.jit(make_eval_step(self.compiled))
+            self._val_cache = DeviceEvalCache()
         from elephas_tpu.engine.step import weighted_mean_over_chunks
 
         # The validation set is constant across a fit's epoch fires:
-        # upload it ONCE and slice on device — re-uploading ~100MB per
-        # epoch costs multiple seconds on a remote-tunneled chip. Keyed
-        # by object IDENTITY with the host arrays kept referenced, so a
-        # recycled id() can never serve a stale device copy.
-        src = getattr(self, "_val_cache_src", None)
-        if src is None or src[0] is not features or src[1] is not labels:
-            self._val_cache = (jnp.asarray(features), jnp.asarray(labels))
-            self._val_cache_src = (features, labels)
-        features_d, labels_d = self._val_cache
+        # sets within the cache bound are uploaded ONCE and sliced on
+        # device (re-uploading ~100MB per epoch costs seconds on a
+        # remote-tunneled chip); larger sets stream per chunk.
+        features = np.asarray(features)
+        labels = np.asarray(labels)
+        cached = self._val_cache.get(
+            (features, labels),
+            features.nbytes + labels.nbytes,
+            lambda: (jnp.asarray(features), jnp.asarray(labels)),
+        )
 
         n = len(features)
         usable = (n // batch_size) * batch_size
@@ -113,11 +115,11 @@ class AsyncTrainer:
             spans.append((usable, n))
 
         def eval_chunk(start, stop):
-            return jax.device_get(
-                self._local_eval_fn(
-                    state, features_d[start:stop], labels_d[start:stop]
-                )
-            )
+            if cached is not None:
+                x, y = cached[0][start:stop], cached[1][start:stop]
+            else:
+                x, y = jnp.asarray(features[start:stop]), jnp.asarray(labels[start:stop])
+            return jax.device_get(self._local_eval_fn(state, x, y))
 
         return weighted_mean_over_chunks(spans, eval_chunk, n)
 
@@ -236,7 +238,6 @@ class AsyncTrainer:
         fire_lock = threading.Lock()  # serializes barrier work (snapshot/val/callbacks)
         fire_queue: deque = deque()
         val_records: List[Optional[Dict[str, float]]] = [None] * epochs
-        val_trainer = None
 
         def pull_snapshot():
             if server is not None:
@@ -289,7 +290,7 @@ class AsyncTrainer:
                     epochs_fired += 1
             # Serial FIFO drain under fire_lock: at most one epoch's
             # barrier work runs at a time, in epoch order — concurrent
-            # fires raced val_trainer creation and Orbax saves are not
+            # fires raced evaluator creation and Orbax saves are not
             # thread-safe (advisor r2). Workers with nothing to drain
             # return WITHOUT touching fire_lock, so an in-flight fire
             # (snapshot + validation + checkpoint) never stalls the
@@ -401,19 +402,15 @@ class AsyncTrainer:
         }
         def fill_val_gaps(records):
             """Defensive: every barrier fires when no worker errored, but a
-            None entry must not ship — evaluate the final state ONCE."""
-            nonlocal val_trainer
+            None entry must not ship — evaluate the final state ONCE.
+            Single-device eval: multi-host, this runs on host 0 while
+            peers are already parked in the broadcast collective, so an
+            SPMD evaluate here would desync the job."""
             fallback = None
             for epoch, val in enumerate(records):
                 if val is None:
                     if fallback is None:
-                        if val_trainer is None:
-                            from elephas_tpu.engine.sync import SyncTrainer
-
-                            val_trainer = SyncTrainer(
-                                compiled, self.mesh, frequency="batch"
-                            )
-                        fallback = val_trainer.evaluate_state(state, *validation_data)
+                        fallback = self._local_evaluate(state, *validation_data)
                     records[epoch] = fallback
             return records
 

@@ -116,6 +116,46 @@ def weighted_mean_over_chunks(spans, eval_chunk, n: int) -> Dict[str, float]:
     return {k: v / n for k, v in totals.items()}
 
 
+_EVAL_CACHE_MAX_BYTES = 1 << 30  # pin eval sets up to 1 GiB on device
+
+
+class DeviceEvalCache:
+    """One-slot device cache for arrays evaluated repeatedly (per-epoch
+    validation): uploading the set once and slicing on device saves a
+    full re-upload per epoch (seconds on a remote-tunneled chip).
+
+    Keyed by object IDENTITY for arrays (host references are retained so
+    a recycled ``id`` can never serve a stale copy) and equality for
+    scalars. Sets larger than ``_EVAL_CACHE_MAX_BYTES`` are NOT cached —
+    ``get`` returns None and the caller streams chunk-at-a-time as
+    before, so huge eval sets keep their bounded-memory behavior.
+    """
+
+    def __init__(self):
+        self._key = None
+        self._dev = None
+
+    @staticmethod
+    def _same(a, b):
+        import numpy as _np
+
+        if isinstance(a, _np.ndarray) or isinstance(b, _np.ndarray):
+            return a is b
+        return a == b
+
+    def get(self, key: tuple, nbytes: int, make: Callable):
+        if nbytes > _EVAL_CACHE_MAX_BYTES:
+            return None
+        if (
+            self._key is None
+            or len(self._key) != len(key)
+            or not all(self._same(a, b) for a, b in zip(self._key, key))
+        ):
+            self._dev = make()
+            self._key = key
+        return self._dev
+
+
 def make_predict_step(compiled) -> Callable:
     def predict_step(state: TrainState, x):
         return compiled.apply_eval(state.params, state.batch_stats, x)

@@ -5,6 +5,13 @@ Real CIFAR-10 when cached (``elephas_tpu.data.datasets``), synthetic
 otherwise; asserts a validation threshold so it doubles as a smoke test.
 """
 
+import os
+import sys
+
+# Runnable as `python examples/<name>.py` from anywhere: the package
+# lives one level up from this file, not on the default sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import jax

@@ -5,6 +5,13 @@ worker, driver takes the argmin. Search space uses the hp combinators
 (the hyperas/hyperopt analogue).
 """
 
+import os
+import sys
+
+# Runnable as `python examples/<name>.py` from anywhere: the package
+# lives one level up from this file, not on the default sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from elephas_tpu import HyperParamModel, SparkModel, compile_model, hp, to_simple_rdd

@@ -6,6 +6,13 @@ DataFrame with predictions. Real IMDB when cached
 asserts a held-out accuracy threshold so it doubles as a smoke test.
 """
 
+import os
+import sys
+
+# Runnable as `python examples/<name>.py` from anywhere: the package
+# lives one level up from this file, not on the default sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from elephas_tpu import ElephasEstimator

@@ -7,6 +7,13 @@ stand-in. Ends with a threshold assert so it doubles as a smoke test
 (SURVEY.md §4 "examples as smoke tests").
 """
 
+import os
+import sys
+
+# Runnable as `python examples/<name>.py` from anywhere: the package
+# lives one level up from this file, not on the default sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 from elephas_tpu import SparkModel, compile_model, to_simple_rdd
