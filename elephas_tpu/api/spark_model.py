@@ -174,6 +174,15 @@ class TpuModel:
         if dataset.labels is None:
             raise ValueError("fit needs labels")
 
+        if validation_data is not None:
+            # Normalize ONCE: downstream per-epoch validation caches the
+            # device copy keyed by object identity, so the same array
+            # objects must flow through the whole fit (and lists must not
+            # reach nbytes-based size checks).
+            validation_data = (
+                np.asarray(validation_data[0]),
+                np.asarray(validation_data[1]),
+            )
         if validation_data is None and validation_split > 0:
             n_val = int(len(dataset) * validation_split)
             if n_val:

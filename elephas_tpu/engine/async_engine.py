@@ -340,23 +340,28 @@ class AsyncTrainer:
         if multi_host:
             # PS-backed host barriers (not device collectives): async hosts
             # can drift by minutes, far past collective-rendezvous deadlines.
-            n_hosts = jax.process_count()
-            ctl = server.client() if server is not None else remote_client_factory()
-            ctl.wait_barrier("elephas:pushes_done", n_hosts)
-            final = pull_snapshot()
-            if server is not None:
-                # Host 0 keeps the PS alive until every peer has announced
-                # its final read, then tears it down.
-                ctl.wait_barrier("elephas:final_read", n_hosts)
-            else:
-                # Peers only announce — waiting here would race the
-                # server shutdown (host 0 stops the PS once the count
-                # completes, possibly mid-poll).
-                ctl.barrier_arrive("elephas:final_read")
-            if hasattr(ctl, "close"):
-                ctl.close()
-            if server is not None:
-                server.stop()
+            # A dead peer surfaces as wait_barrier's TimeoutError (bounded
+            # by $ELEPHAS_BARRIER_TIMEOUT); the finally stops the PS so a
+            # failed teardown never leaks the server thread.
+            try:
+                n_hosts = jax.process_count()
+                ctl = server.client() if server is not None else remote_client_factory()
+                ctl.wait_barrier("elephas:pushes_done", n_hosts)
+                final = pull_snapshot()
+                if server is not None:
+                    # Host 0 keeps the PS alive until every peer has announced
+                    # its final read, then tears it down.
+                    ctl.wait_barrier("elephas:final_read", n_hosts)
+                else:
+                    # Peers only announce — waiting here would race the
+                    # server shutdown (host 0 stops the PS once the count
+                    # completes, possibly mid-poll).
+                    ctl.barrier_arrive("elephas:final_read")
+                if hasattr(ctl, "close"):
+                    ctl.close()
+            finally:
+                if server is not None:
+                    server.stop()
         else:
             final = jax.device_get(server.get_parameters())
             server.stop()

@@ -496,6 +496,11 @@ class SyncTrainer:
         validation); larger sets stream chunk-at-a-time as always.
         """
         eval_fn = self._eval_fn
+        # No-op for ndarray (identity preserved for the cache); converts
+        # list inputs so the size check below can't crash. List callers
+        # miss the cache (fresh object per call) but stay correct.
+        features = np.asarray(features)
+        labels = np.asarray(labels)
         n = len(features)
         usable = (n // self.n_shards) * self.n_shards
         if not hasattr(self, "_eval_cache"):

@@ -24,10 +24,12 @@ connection would stall ``timeout``-per-attempt against a wedged server.
 from __future__ import annotations
 
 import http.client
+import os
 import pickle
 import socket
 import threading
 import time
+from typing import Optional
 
 import jax
 
@@ -79,7 +81,7 @@ class LocalClient(BaseParameterClient):
     def update_parameters(self, delta) -> None:
         self._buffer.apply_delta(delta)
 
-    def wait_barrier(self, tag: str, n: int, timeout: float = 600.0) -> None:
+    def wait_barrier(self, tag: str, n: int, timeout: Optional[float] = None) -> None:
         pass  # in-process buffer == single host; nothing to synchronize
 
 
@@ -97,7 +99,13 @@ class _WireBarrierMixin:
     def barrier_count(self, tag: str) -> int:
         raise NotImplementedError
 
-    def wait_barrier(self, tag: str, n: int, timeout: float = 600.0) -> None:
+    def wait_barrier(self, tag: str, n: int, timeout: Optional[float] = None) -> None:
+        """Arrive, then poll until ``n`` hosts arrived or ``timeout``
+        (default ``$ELEPHAS_BARRIER_TIMEOUT``, 600s) — a dead peer host
+        surfaces as a TimeoutError naming the barrier, not a silent hang
+        (the reference relied on Spark killing the whole job)."""
+        if timeout is None:
+            timeout = float(os.environ.get("ELEPHAS_BARRIER_TIMEOUT", "600"))
         self.barrier_arrive(tag)
         deadline = time.monotonic() + timeout
         poll = 0.02
@@ -107,7 +115,8 @@ class _WireBarrierMixin:
             time.sleep(poll)
             poll = min(poll * 2, 0.5)
         raise TimeoutError(
-            f"barrier {tag!r}: {self.barrier_count(tag)}/{n} hosts after {timeout}s"
+            f"barrier {tag!r}: {self.barrier_count(tag)}/{n} hosts after {timeout}s "
+            "— a peer host likely died; restart the job from the latest checkpoint"
         )
 
 

@@ -1,0 +1,72 @@
+"""Units for the shared eval helpers in ``engine/step.py``:
+``weighted_mean_over_chunks`` (exact weighted metric mean, reference
+§3.5 semantics) and ``DeviceEvalCache`` (one-slot identity-keyed device
+cache with a size bound — serving repeated per-epoch validation without
+per-epoch re-uploads, and streaming for oversized sets)."""
+
+import numpy as np
+
+from elephas_tpu.engine import step as step_mod
+from elephas_tpu.engine.step import DeviceEvalCache, weighted_mean_over_chunks
+
+
+def test_weighted_mean_exact_over_ragged_chunks():
+    # 10 rows in chunks of 4/4/2; metric = mean of values per chunk.
+    values = np.arange(10, dtype=np.float64)
+    spans = [(0, 4), (4, 8), (8, 10)]
+
+    def eval_chunk(start, stop):
+        return {"m": float(values[start:stop].mean())}
+
+    out = weighted_mean_over_chunks(spans, eval_chunk, 10)
+    assert out == {"m": float(values.mean())}
+
+
+def test_weighted_mean_passes_extra_span_fields():
+    spans = [(0, 2, "tag"), (2, 3, "tag2")]
+    seen = []
+
+    def eval_chunk(start, stop, tag):
+        seen.append(tag)
+        return {"m": 1.0}
+
+    assert weighted_mean_over_chunks(spans, eval_chunk, 3) == {"m": 1.0}
+    assert seen == ["tag", "tag2"]
+
+
+def test_device_eval_cache_hits_on_identity_and_rebuilds_on_new_arrays():
+    cache = DeviceEvalCache()
+    a, b = np.zeros(4), np.ones(4)
+    builds = []
+
+    def make():
+        builds.append(1)
+        return ("built", len(builds))
+
+    first = cache.get((a, b), a.nbytes + b.nbytes, make)
+    again = cache.get((a, b), a.nbytes + b.nbytes, make)
+    assert first == again == ("built", 1) and len(builds) == 1
+    # equal CONTENT but different object ⇒ rebuild (identity semantics:
+    # a recycled id with different data must never be served stale)
+    a2 = np.zeros(4)
+    rebuilt = cache.get((a2, b), a2.nbytes + b.nbytes, make)
+    assert rebuilt == ("built", 2)
+
+
+def test_device_eval_cache_scalar_key_participates():
+    cache = DeviceEvalCache()
+    a = np.zeros(4)
+    builds = []
+    cache.get((a, 8), a.nbytes, lambda: builds.append(1))
+    cache.get((a, 8), a.nbytes, lambda: builds.append(1))
+    cache.get((a, 12), a.nbytes, lambda: builds.append(1))  # usable changed
+    assert len(builds) == 2
+
+
+def test_device_eval_cache_declines_oversized_sets(monkeypatch):
+    monkeypatch.setattr(step_mod, "_EVAL_CACHE_MAX_BYTES", 100)
+    cache = DeviceEvalCache()
+    big = np.zeros(200, dtype=np.uint8)
+    assert cache.get((big,), big.nbytes, lambda: "never") is None
+    small = np.zeros(10, dtype=np.uint8)
+    assert cache.get((small,), small.nbytes, lambda: "yes") == "yes"

@@ -11,9 +11,12 @@ final weights (everyone pulls the single PS at the end).
 
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
+import time
+import urllib.request
 
 import pytest
 
@@ -53,7 +56,8 @@ model = SparkModel(
     net, mode=mode, frequency="epoch",
     parameter_server_mode=psmode, num_workers=8, port=port,
 )
-history = model.fit(to_simple_rdd(None, x, y, 8), epochs=3, batch_size=16)
+epochs = int(os.environ.get("ELEPHAS_TEST_EPOCHS", "3"))
+history = model.fit(to_simple_rdd(None, x, y, 8), epochs=epochs, batch_size=16)
 weights = jax.tree_util.tree_leaves(model.get_weights())
 digest = hashlib.md5(b"".join(np.asarray(w).tobytes() for w in weights)).hexdigest()
 print("RESULT " + __import__("json").dumps(
@@ -112,3 +116,69 @@ def test_two_process_training_all_modes(tmp_path, mode, ps_mode):
     # one PS: both processes end with identical weights and a trained model
     assert results[0]["digest"] == results[1]["digest"]
     assert results[0]["acc"] > 0.8
+
+
+def test_peer_host_death_surfaces_as_barrier_timeout(tmp_path):
+    """Kill host 1 mid-async-fit: host 0 must fail with wait_barrier's
+    TimeoutError within the configured budget instead of hanging — the
+    TPU-native stand-in for Spark's job-level failure detection
+    (SURVEY.md §5.3; the reference would rely on Spark killing the job)."""
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    coord = f"127.0.0.1:{_free_port()}"
+    ps_port = _free_port()
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["ELEPHAS_PS_BIND"] = "127.0.0.1"
+    env["ELEPHAS_BARRIER_TIMEOUT"] = "12"
+    # Long fit: the kill must land MID-training — with the default 3
+    # epochs a fast machine can finish before the first 0.3s progress
+    # poll observes a weight change, making the kill a no-op.
+    env["ELEPHAS_TEST_EPOCHS"] = "60"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", coord, "http",
+             str(ps_port), "asynchronous"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        # Wait for the PS, then for training to be underway on host 0 —
+        # which implies the address-broadcast collective completed, so
+        # host 1 is past it too (killing it earlier would strand host 0
+        # inside the collective rather than the barrier under test).
+        deadline = time.time() + 180
+        base = f"http://127.0.0.1:{ps_port}"
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(f"{base}/health", timeout=1):
+                    break
+            except Exception:
+                time.sleep(0.2)
+        else:
+            raise AssertionError("parameter server never came up")
+
+        def weights_bytes():
+            with urllib.request.urlopen(f"{base}/parameters", timeout=10) as r:
+                return r.read()
+
+        first = weights_bytes()
+        while time.time() < deadline:
+            if weights_bytes() != first:
+                break  # a worker pushed: training underway
+            time.sleep(0.3)
+        else:
+            raise AssertionError("no training progress observed")
+
+        os.kill(procs[1].pid, signal.SIGKILL)
+        out0, err0 = procs[0].communicate(timeout=180)
+        assert procs[0].returncode != 0, "host 0 should fail, not succeed"
+        assert "barrier" in err0 and "TimeoutError" in err0, err0[-2000:]
+        assert "peer host likely died" in err0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=30)

@@ -172,3 +172,26 @@ def test_second_evaluate_hits_jit_cache(data):
     psize = trainer._predict_fn._cache_size()
     model.predict(x)
     assert trainer._predict_fn._cache_size() == psize
+
+
+def test_fit_accepts_list_validation_data(blobs):
+    """validation_data as plain Python lists must work (normalized once
+    at the fit boundary so the per-epoch device eval cache keys on
+    stable ndarray objects and size checks never see list inputs)."""
+    from elephas_tpu import SparkModel, compile_model, to_simple_rdd
+    from elephas_tpu.models import get_model
+
+    x, y = blobs
+    net = compile_model(
+        get_model("mlp", features=(16,), num_classes=4),
+        optimizer={"name": "sgd", "learning_rate": 0.05},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(x.shape[1],),
+    )
+    model = SparkModel(net, mode="synchronous", frequency="epoch", num_workers=2)
+    history = model.fit(
+        to_simple_rdd(None, x, y, 2), epochs=2, batch_size=16,
+        validation_data=(x[:64].tolist(), y[:64].tolist()),
+    )
+    assert len(history["val_acc"]) == 2
