@@ -343,6 +343,7 @@ class AsyncTrainer:
             # A dead peer surfaces as wait_barrier's TimeoutError (bounded
             # by $ELEPHAS_BARRIER_TIMEOUT); the finally stops the PS so a
             # failed teardown never leaks the server thread.
+            ctl = None
             try:
                 n_hosts = jax.process_count()
                 ctl = server.client() if server is not None else remote_client_factory()
@@ -357,9 +358,9 @@ class AsyncTrainer:
                     # server shutdown (host 0 stops the PS once the count
                     # completes, possibly mid-poll).
                     ctl.barrier_arrive("elephas:final_read")
-                if hasattr(ctl, "close"):
-                    ctl.close()
             finally:
+                if ctl is not None and hasattr(ctl, "close"):
+                    ctl.close()
                 if server is not None:
                     server.stop()
         else:

@@ -72,12 +72,23 @@ def _blockwise_reference(q, k, v, causal: bool, block_q: int, block_k: int):
     return out[:, :, :sq]
 
 
+# Below this sequence length the XLA blockwise path beats the Pallas
+# kernels on-chip (kernel-launch/tiling overhead dominates; measured
+# 2026-07-30: XLA 0.67x faster at 2048, Pallas 1.4x at 4096 and 2.7x at
+# 8192 fwd+bwd — `scripts/attention_bench.py`).
+_PALLAS_MIN_SEQ = 4096
+
+
 def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _use_pallas(q) -> bool:
+    return _on_tpu() and q.shape[2] >= _PALLAS_MIN_SEQ
+
+
 def _forward_impl(q, k, v, causal, block_q, block_k):
-    if _on_tpu():
+    if _use_pallas(q):
         from elephas_tpu.ops.attention_pallas import pallas_flash_attention
 
         return pallas_flash_attention(
@@ -92,7 +103,7 @@ def _flash(q, k, v, causal, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    if _on_tpu():
+    if _use_pallas(q):
         from elephas_tpu.ops.attention_pallas import pallas_flash_attention
 
         # Save (o, lse) so the backward recomputes attention weights from
@@ -129,8 +140,13 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512):
-    """Blockwise attention; Pallas forward on TPU, XLA blockwise elsewhere.
+    """Blockwise attention with flash memory semantics at every length:
+    the custom VJP recomputes attention weights in backward (never
+    retaining O(seq^2) residuals), with the KERNEL chosen per length —
+    Pallas on TPU for seq >= ``_PALLAS_MIN_SEQ`` where its fused
+    backward wins (2.7x at 8k), XLA blockwise below, where Pallas
+    launch/tiling overhead loses (scripts/attention_bench.py).
 
-    Differentiable (custom VJP). q/k/v: (batch, heads, seq, head_dim).
+    Differentiable. q/k/v: (batch, heads, seq, head_dim).
     """
     return _flash(q, k, v, causal, block_q, block_k)

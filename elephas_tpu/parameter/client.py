@@ -114,8 +114,11 @@ class _WireBarrierMixin:
                 return
             time.sleep(poll)
             poll = min(poll * 2, 0.5)
+        final = self.barrier_count(tag)  # re-check: peer may have arrived
+        if final >= n:                   # during the last sleep interval
+            return
         raise TimeoutError(
-            f"barrier {tag!r}: {self.barrier_count(tag)}/{n} hosts after {timeout}s "
+            f"barrier {tag!r}: {final}/{n} hosts after {timeout}s "
             "— a peer host likely died; restart the job from the latest checkpoint"
         )
 

@@ -1,0 +1,90 @@
+"""Long-context attention bench: Pallas flash kernels vs the XLA
+blockwise fallback, fwd+bwd, on the real chip (SURVEY.md §5.7 upgrade).
+
+Emits one JSON line per (seq_len, impl) with ms/step and achieved
+throughput so the speedup is a committed artifact rather than something
+each reviewer re-measures (r2 VERDICT verified 2.05x at seq 8192 by
+hand — this script reproduces that table).
+
+Usage: python scripts/attention_bench.py [--seqs 2048 4096 8192] [--steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build(seq: int, impl: str, heads: int = 8, dim: int = 64, batch: int = 1):
+    from elephas_tpu.ops import attention as attn
+
+    def loss_fn(q, k, v):
+        if impl == "pallas":
+            # Force the Pallas custom-VJP path regardless of the public
+            # API's _PALLAS_MIN_SEQ dispatch (this script MEASURES the
+            # crossover that dispatch encodes).
+            import unittest.mock as mock
+
+            with mock.patch.object(attn, "_use_pallas", lambda q_: True):
+                out = attn._flash(q, k, v, True, 512, 512)
+        else:
+            out = attn._blockwise_reference(q, k, v, True, 512, 512)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1, 2)))
+    rng = np.random.default_rng(0)
+    shape = (batch, heads, seq, dim)
+    q, k, v = (
+        jax.device_put(rng.normal(size=shape).astype(np.float32).astype(jnp.bfloat16))
+        for _ in range(3)
+    )
+    return grad_fn, (q, k, v)
+
+
+def measure(fn, args, steps: int, warmup: int = 3) -> float:
+    for _ in range(warmup):
+        loss, grads = fn(*args)
+    float(loss)  # force the chain (axon: block_until_ready lies)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, grads = fn(*args)
+    float(loss)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", type=int, nargs="*", default=[2048, 4096, 8192])
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args()
+
+    print(f"devices={jax.devices()}", file=sys.stderr)
+    by_seq = {}
+    for seq in args.seqs:
+        for impl in ("xla_blockwise", "pallas"):
+            fn, data = build(seq, impl)
+            sec = measure(fn, data, args.steps)
+            by_seq.setdefault(seq, {})[impl] = sec
+            print(json.dumps({
+                "seq": seq, "impl": impl, "fwd_bwd_ms": round(sec * 1e3, 2),
+            }), flush=True)
+            del fn, data
+    for seq, r in by_seq.items():
+        if len(r) == 2:
+            print(json.dumps({
+                "seq": seq,
+                "speedup_pallas_vs_xla": round(r["xla_blockwise"] / r["pallas"], 2),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
