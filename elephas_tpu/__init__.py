@@ -30,6 +30,7 @@ from elephas_tpu.api.spark_model import (  # noqa: F401
     load_spark_model,
 )
 from elephas_tpu.api.compile import CompiledModel, compile_model  # noqa: F401
+from elephas_tpu.serialize.keras_bridge import from_keras  # noqa: F401
 from elephas_tpu.data.rdd import ShardedDataset, to_simple_rdd  # noqa: F401
 from elephas_tpu.data.dataframe import DataFrame  # noqa: F401
 from elephas_tpu.ml import ElephasEstimator, ElephasTransformer  # noqa: F401

@@ -75,10 +75,22 @@ class TpuModel:
             from elephas_tpu.serialize.serialization import dict_to_model
 
             model = dict_to_model(model, custom_objects)
+        elif not isinstance(model, CompiledModel) and (
+            type(model).__module__.split(".")[0] == "keras"
+            or hasattr(model, "stateless_call")
+        ):
+            # Reference drop-in: ``SparkModel(compiled_keras_model, ...)``
+            # — ingest through the Keras-3 bridge, reading the model's own
+            # compile() configuration (elephas/spark_model.py::SparkModel
+            # takes the user's compiled Keras model directly).
+            from elephas_tpu.serialize.keras_bridge import from_keras
+
+            model = from_keras(model)
         if not isinstance(model, CompiledModel):
             raise TypeError(
-                "model must be a CompiledModel (or a model_to_dict payload); "
-                "wrap flax modules with elephas_tpu.compile_model"
+                "model must be a CompiledModel, a compiled Keras-3 model, "
+                "or a model_to_dict payload; wrap flax modules with "
+                "elephas_tpu.compile_model"
             )
         self._master = model
         self.mode = mode

@@ -74,8 +74,9 @@ def _blockwise_reference(q, k, v, causal: bool, block_q: int, block_k: int):
 
 # Below this sequence length the XLA blockwise path beats the Pallas
 # kernels on-chip (kernel-launch/tiling overhead dominates; measured
-# 2026-07-30: XLA 0.67x faster at 2048, Pallas 1.4x at 4096 and 2.7x at
-# 8192 fwd+bwd — `scripts/attention_bench.py`).
+# 2026-07-30 fwd+bwd: Pallas runs at 0.67x the XLA speed at 2048 —
+# i.e. XLA ~1.5x faster — while Pallas wins 1.4x at 4096 and 2.7x at
+# 8192 — `scripts/attention_bench.py`).
 _PALLAS_MIN_SEQ = 4096
 
 
