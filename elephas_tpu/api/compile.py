@@ -35,11 +35,39 @@ OPTIMIZERS: Dict[str, Tuple[Callable, Dict[str, Any]]] = {
 }
 
 
+# Serializable learning-rate schedules (Keras-parity: Keras optimizers
+# accept LearningRateSchedule objects; these configs map to optax).
+SCHEDULES: Dict[str, Callable] = {
+    "constant": optax.constant_schedule,
+    "exponential_decay": optax.exponential_decay,
+    "cosine_decay": optax.cosine_decay_schedule,
+    "piecewise_constant": optax.piecewise_constant_schedule,
+    "warmup_cosine": optax.warmup_cosine_decay_schedule,
+}
+
+
+def resolve_schedule(lr):
+    """A learning rate may be a float, an optax schedule callable, or a
+    serializable ``{"schedule": <name>, **kwargs}`` config (per-STEP
+    schedules — optax counts optimizer updates)."""
+    if isinstance(lr, dict):
+        spec = dict(lr)
+        name = spec.pop("schedule").lower()
+        if name not in SCHEDULES:
+            raise ValueError(
+                f"unknown lr schedule {name!r}; known: {sorted(SCHEDULES)}"
+            )
+        return SCHEDULES[name](**spec)
+    return lr
+
+
 def resolve_optimizer(optimizer) -> Tuple[optax.GradientTransformation, Optional[dict]]:
     """Resolve an optimizer spec to (transform, serializable_config).
 
     Accepts an optax transform (config None — not re-serializable), a
-    Keras-style name, or ``{"name": ..., **kwargs}``.
+    Keras-style name, or ``{"name": ..., **kwargs}`` where
+    ``learning_rate`` may be a float or a ``{"schedule": ...}`` config
+    (see ``resolve_schedule``).
     """
     if isinstance(optimizer, str):
         spec = {"name": optimizer}
@@ -52,7 +80,9 @@ def resolve_optimizer(optimizer) -> Tuple[optax.GradientTransformation, Optional
         raise ValueError(f"unknown optimizer {name!r}; known: {sorted(OPTIMIZERS)}")
     builder, defaults = OPTIMIZERS[name]
     kwargs = {**defaults, **spec}
-    return builder(**kwargs), {"name": name, **kwargs}
+    build_kwargs = dict(kwargs)
+    build_kwargs["learning_rate"] = resolve_schedule(build_kwargs["learning_rate"])
+    return builder(**build_kwargs), {"name": name, **kwargs}
 
 
 class CompiledModel:

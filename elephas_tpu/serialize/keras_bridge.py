@@ -115,11 +115,72 @@ def _optimizer_from_keras(keras_opt) -> dict:
             "optimizer=... explicitly"
         )
     lr = keras_opt.learning_rate
+    schedule = getattr(keras_opt, "_learning_rate", None)
+    schedule_config = _schedule_from_keras(schedule)
+    if schedule_config is not None:
+        return {"name": name, "learning_rate": schedule_config}
     try:
         lr = float(lr.value if hasattr(lr, "value") else lr)
-    except TypeError:  # schedule object
+    except TypeError:  # unmapped schedule object: start-of-training value
         lr = float(lr(0))
     return {"name": name, "learning_rate": lr}
+
+
+def _schedule_from_keras(schedule) -> Optional[dict]:
+    """Map a Keras LearningRateSchedule to a serializable optax-schedule
+    config (``resolve_schedule``). Unmapped schedules return None and
+    fall back to the schedule's step-0 value (previous behavior).
+
+    Caveat: Keras counts ITERATIONS exactly as optax counts updates, so
+    the decay step semantics line up 1:1.
+    """
+    if schedule is None or not hasattr(schedule, "get_config"):
+        return None
+    kind = type(schedule).__name__
+    cfg = schedule.get_config()
+    if kind == "ExponentialDecay":
+        return {
+            "schedule": "exponential_decay",
+            "init_value": float(cfg["initial_learning_rate"]),
+            "transition_steps": int(cfg["decay_steps"]),
+            "decay_rate": float(cfg["decay_rate"]),
+            "staircase": bool(cfg.get("staircase", False)),
+        }
+    if kind == "CosineDecay":
+        if cfg.get("warmup_steps"):
+            peak = float(cfg.get("warmup_target") or cfg["initial_learning_rate"])
+            return {
+                "schedule": "warmup_cosine",
+                # Keras warmup ramps linearly FROM initial_learning_rate
+                # to warmup_target.
+                "init_value": float(cfg["initial_learning_rate"]),
+                "peak_value": peak,
+                "warmup_steps": int(cfg["warmup_steps"]),
+                # optax decay_steps is the TOTAL schedule length including
+                # warmup; Keras decay_steps counts only the cosine phase.
+                "decay_steps": int(cfg["warmup_steps"]) + int(cfg["decay_steps"]),
+                "end_value": float(cfg.get("alpha", 0.0)) * peak,
+            }
+        return {
+            "schedule": "cosine_decay",
+            "init_value": float(cfg["initial_learning_rate"]),
+            "decay_steps": int(cfg["decay_steps"]),
+            "alpha": float(cfg.get("alpha", 0.0)),
+        }
+    if kind == "PiecewiseConstantDecay":
+        bounds = [int(b) for b in cfg["boundaries"]]
+        values = [float(v) for v in cfg["values"]]
+        return {
+            "schedule": "piecewise_constant",
+            "init_value": values[0],
+            # optax piecewise_constant multiplies by scale at each
+            # boundary: scale_i = values[i+1]/values[i]
+            "boundaries_and_scales": {
+                int(b): float(values[i + 1] / values[i])
+                for i, b in enumerate(bounds)
+            },
+        }
+    return None
 
 
 def _final_activation_name(keras_model) -> str:
