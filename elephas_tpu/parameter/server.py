@@ -52,8 +52,10 @@ class LocalServer(BaseParameterServer):
     handing out a buffer handle; pulls are device-to-device copies.
     """
 
-    def __init__(self, params, lock: bool = True, device: Optional[jax.Device] = None):
-        self.buffer = ParameterBuffer(params, lock=lock, device=device)
+    def __init__(self, params, lock: bool = True, device: Optional[jax.Device] = None,
+                 granularity: str = "tree"):
+        self.buffer = ParameterBuffer(params, lock=lock, device=device,
+                                      granularity=granularity)
 
     def start(self) -> None:
         pass
@@ -107,8 +109,10 @@ class HttpServer(BaseParameterServer):
         port: int = 4000,
         device: Optional[jax.Device] = None,
         host: Optional[str] = None,
+        granularity: str = "tree",
     ):
-        self.buffer = ParameterBuffer(params, lock=lock, device=device)
+        self.buffer = ParameterBuffer(params, lock=lock, device=device,
+                                      granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
         self.port = port
         self.barriers = _BarrierBook()
@@ -229,8 +233,10 @@ class SocketServer(BaseParameterServer):
         port: int = 4000,
         device: Optional[jax.Device] = None,
         host: Optional[str] = None,
+        granularity: str = "tree",
     ):
-        self.buffer = ParameterBuffer(params, lock=lock, device=device)
+        self.buffer = ParameterBuffer(params, lock=lock, device=device,
+                                      granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
         self.port = port
         self.barriers = _BarrierBook()
@@ -268,12 +274,17 @@ def make_server(
     port: int = 4000,
     device: Optional[jax.Device] = None,
     host: Optional[str] = None,
+    granularity: str = "tree",
 ) -> BaseParameterServer:
-    """Factory keyed on the reference's ``parameter_server_mode``."""
+    """Factory keyed on the reference's ``parameter_server_mode``.
+    ``granularity`` ('tree'|'leaf') sets the hogwild apply isolation —
+    see ``ParameterBuffer``'s memory-model note."""
     if mode == "local":
-        return LocalServer(params, lock=lock, device=device)
+        return LocalServer(params, lock=lock, device=device, granularity=granularity)
     if mode == "http":
-        return HttpServer(params, lock=lock, port=port, device=device, host=host)
+        return HttpServer(params, lock=lock, port=port, device=device, host=host,
+                          granularity=granularity)
     if mode == "socket":
-        return SocketServer(params, lock=lock, port=port, device=device, host=host)
+        return SocketServer(params, lock=lock, port=port, device=device, host=host,
+                            granularity=granularity)
     raise ValueError(f"parameter_server_mode must be local|http|socket, got {mode!r}")

@@ -24,8 +24,10 @@ N_THREADS = 8
 N_UPDATES = 150  # per thread; integer-valued f32 stays exact far past this
 
 
-def _hammer(buffer: ParameterBuffer) -> None:
-    delta = {"w": -np.ones(8, dtype=np.float32)}  # apply is W -= delta → +1
+def _hammer(buffer: ParameterBuffer, n_leaves: int = 1) -> None:
+    delta = {
+        f"w{i}": -np.ones(8, dtype=np.float32) for i in range(n_leaves)
+    }  # apply is W -= delta → +1
     barrier = threading.Barrier(N_THREADS)
 
     def worker():
@@ -41,19 +43,19 @@ def _hammer(buffer: ParameterBuffer) -> None:
 
 
 def test_locked_buffer_applies_every_update():
-    buffer = ParameterBuffer({"w": np.zeros(8, dtype=np.float32)}, lock=True)
+    buffer = ParameterBuffer({"w0": np.zeros(8, dtype=np.float32)}, lock=True)
     _hammer(buffer)
     total = N_THREADS * N_UPDATES
-    applied = float(np.asarray(jax.device_get(buffer.get())["w"])[0])
+    applied = float(np.asarray(jax.device_get(buffer.get())["w0"])[0])
     assert applied == total, f"locked mode lost {total - applied} updates"
     assert buffer.version == total
 
 
 def test_hogwild_lost_update_rate_measured():
-    buffer = ParameterBuffer({"w": np.zeros(8, dtype=np.float32)}, lock=False)
+    buffer = ParameterBuffer({"w0": np.zeros(8, dtype=np.float32)}, lock=False)
     _hammer(buffer)
     total = N_THREADS * N_UPDATES
-    w = np.asarray(jax.device_get(buffer.get())["w"])
+    w = np.asarray(jax.device_get(buffer.get())["w0"])
     # No torn/corrupt values: every element saw the same whole-delta sum.
     assert np.all(w == w[0]), w
     applied = float(w[0])
@@ -69,3 +71,41 @@ def test_hogwild_lost_update_rate_measured():
     assert 0.0 < fraction <= 1.0
     print(f"hogwild applied-update fraction: {fraction:.3f} "
           f"({int(applied)}/{total})")
+
+
+def test_leaf_granularity_applied_fraction_floor():
+    """granularity='leaf' stores each leaf in its own GIL-atomic slot, so
+    contention drops at most overlapping LEAVES, never whole deltas.
+    Asserts the contract's measurable consequence — applied fraction
+    stays above 0.5 under deliberate contention (measured ~0.80 on this
+    harness, vs whole-tree mode's noisy 0.3–0.9 range; the tree-vs-leaf
+    inequality itself is too flaky to assert), and values stay exact.
+    Also serves HTTP/socket pulls: get_numpy must reconstruct from the
+    leaf store, not the (None) tree pointer."""
+    fracs = []
+    for _ in range(2):
+        buf = ParameterBuffer(
+            {f"w{i}": np.zeros(8, dtype=np.float32) for i in range(4)},
+            lock=False, granularity="leaf",
+        )
+        _hammer(buf, n_leaves=4)
+        w = buf.get_numpy()  # the wire-transport path (regression: was None)
+        assert w is not None and set(w) == {f"w{i}" for i in range(4)}
+        for i in range(4):
+            leaf = np.asarray(w[f"w{i}"])
+            assert np.all(leaf == leaf[0]), leaf  # exact whole-delta sums
+        applied = sum(float(np.asarray(w[f"w{i}"])[0]) for i in range(4)) / 4
+        fracs.append(applied / (N_THREADS * N_UPDATES))
+    assert all(0.5 < f <= 1.0 for f in fracs), fracs
+
+
+def test_leaf_granularity_exact_under_lock():
+    buf = ParameterBuffer(
+        {"a": np.zeros(4, np.float32), "b": np.ones(4, np.float32)},
+        lock=True, granularity="leaf",
+    )
+    for _ in range(5):
+        buf.apply_delta({"a": -np.ones(4, np.float32), "b": np.zeros(4, np.float32)})
+    w = jax.device_get(buf.get())
+    np.testing.assert_array_equal(np.asarray(w["a"]), 5.0)
+    np.testing.assert_array_equal(np.asarray(w["b"]), 1.0)
