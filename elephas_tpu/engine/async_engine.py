@@ -47,7 +47,11 @@ class AsyncTrainer:
         lock: bool = True,
         parameter_server_mode: str = "local",
         port: int = 4000,
+        granularity: str = "tree",
     ):
+        """``granularity`` ('tree'|'leaf'): hogwild apply isolation —
+        'leaf' drops at most racing leaves instead of whole deltas at the
+        cost of one dispatch per leaf per push (ParameterBuffer note)."""
         if frequency not in _FREQUENCIES:
             raise ValueError(
                 f"async frequency must be batch|epoch, got {frequency!r} "
@@ -59,6 +63,7 @@ class AsyncTrainer:
         self.lock = lock
         self.parameter_server_mode = parameter_server_mode
         self.port = port
+        self.granularity = granularity
         # One worker per device along the data axis. Under multi-host SPMD
         # every process constructs the same global mesh but drives only its
         # *addressable* devices; the partition index stays global so shard g
@@ -163,6 +168,7 @@ class AsyncTrainer:
                 lock=self.lock,
                 port=self.port,
                 device=jax.local_devices()[0],
+                granularity=self.granularity,
             )
             server.start()
         else:
@@ -180,6 +186,7 @@ class AsyncTrainer:
                     port=self.port,
                     device=jax.local_devices()[0],
                     host=os.environ.get("ELEPHAS_PS_BIND", "0.0.0.0"),
+                    granularity=self.granularity,
                 )
                 server.start()
             if server is not None:

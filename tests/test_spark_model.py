@@ -195,3 +195,23 @@ def test_fit_accepts_list_validation_data(blobs):
         validation_data=(x[:64].tolist(), y[:64].tolist()),
     )
     assert len(history["val_acc"]) == 2
+
+
+def test_hogwild_leaf_granularity_end_to_end(data):
+    """mode='hogwild' with hogwild_granularity='leaf' trains through the
+    full driver surface (leaf-slot buffer behind the PS) and converges
+    (suite-standard fixtures and loose threshold: lock-free modes drop
+    racing updates by design)."""
+    from elephas_tpu import SparkModel, to_simple_rdd
+
+    x, y = data
+    model = SparkModel(fresh_model(), mode="hogwild", frequency="batch",
+                       num_workers=4, hogwild_granularity="leaf")
+    history = model.fit(to_simple_rdd(None, x, y, 4), epochs=4, batch_size=16)
+    assert history["acc"][-1] > 0.8
+    assert model.evaluate(x, y)["acc"] > 0.8
+
+
+def test_invalid_hogwild_granularity_raises_at_construction():
+    with pytest.raises(ValueError, match="hogwild_granularity"):
+        SparkModel(fresh_model(), mode="hogwild", hogwild_granularity="element")
