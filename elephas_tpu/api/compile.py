@@ -52,7 +52,13 @@ def resolve_schedule(lr):
     schedules — optax counts optimizer updates)."""
     if isinstance(lr, dict):
         spec = dict(lr)
-        name = spec.pop("schedule").lower()
+        name = spec.pop("schedule", None)
+        if not isinstance(name, str):
+            raise ValueError(
+                "dict learning_rate must look like {'schedule': <name str>, "
+                f"**kwargs}}; got {lr!r}"
+            )
+        name = name.lower()
         if name not in SCHEDULES:
             raise ValueError(
                 f"unknown lr schedule {name!r}; known: {sorted(SCHEDULES)}"

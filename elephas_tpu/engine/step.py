@@ -120,20 +120,30 @@ _EVAL_CACHE_MAX_BYTES = 1 << 30  # pin eval sets up to 1 GiB on device
 
 
 class DeviceEvalCache:
-    """One-slot device cache for arrays evaluated repeatedly (per-epoch
-    validation): uploading the set once and slicing on device saves a
-    full re-upload per epoch (seconds on a remote-tunneled chip).
+    """Small LRU device cache for arrays evaluated repeatedly (per-epoch
+    validation): uploading each set once and slicing on device saves a
+    full re-upload per epoch (seconds on a remote-tunneled chip). Holding
+    ``slots`` (default 4) entries means alternating validation sets —
+    e.g. an estimator's val split plus a manual ``evaluate`` call — don't
+    thrash the single slot and silently re-upload ~100MB per call.
 
     Keyed by object IDENTITY for arrays (host references are retained so
     a recycled ``id`` can never serve a stale copy) and equality for
-    scalars. Sets larger than ``_EVAL_CACHE_MAX_BYTES`` are NOT cached —
-    ``get`` returns None and the caller streams chunk-at-a-time as
-    before, so huge eval sets keep their bounded-memory behavior.
+    scalars. The identity key assumes callers do NOT mutate a cached
+    array in place between epochs — ``fit(validation_data=...)`` /
+    ``evaluate`` treat their arrays as immutable snapshots; mutate a
+    copy (or pass a fresh array) to change the eval set. Sets larger
+    than ``_EVAL_CACHE_MAX_BYTES`` are NOT cached — ``get`` returns None
+    and the caller streams chunk-at-a-time as before, so huge eval sets
+    keep their bounded-memory behavior. Cached entries together are
+    bounded by the same byte budget (evicted LRU-first BEFORE the new
+    set uploads), so the worst-case pinned HBM equals the old one-slot
+    cache's — more slots never cost more memory.
     """
 
-    def __init__(self):
-        self._key = None
-        self._dev = None
+    def __init__(self, slots: int = 4):
+        self._slots = max(1, int(slots))
+        self._entries: list = []  # [(key, nbytes, device_value)], most recent last
 
     @staticmethod
     def _same(a, b):
@@ -143,17 +153,30 @@ class DeviceEvalCache:
             return a is b
         return a == b
 
+    def _match(self, key: tuple) -> Optional[int]:
+        for i, (k, _, _) in enumerate(self._entries):
+            if len(k) == len(key) and all(self._same(a, b) for a, b in zip(k, key)):
+                return i
+        return None
+
     def get(self, key: tuple, nbytes: int, make: Callable):
         if nbytes > _EVAL_CACHE_MAX_BYTES:
             return None
-        if (
-            self._key is None
-            or len(self._key) != len(key)
-            or not all(self._same(a, b) for a, b in zip(self._key, key))
+        i = self._match(key)
+        if i is not None:
+            entry = self._entries.pop(i)
+            self._entries.append(entry)  # refresh LRU position
+            return entry[2]
+        # Evict LRU-first until the new set fits BOTH bounds, before the
+        # upload — peak pinned memory never exceeds the byte budget.
+        while self._entries and (
+            len(self._entries) >= self._slots
+            or sum(e[1] for e in self._entries) + nbytes > _EVAL_CACHE_MAX_BYTES
         ):
-            self._dev = make()
-            self._key = key
-        return self._dev
+            self._entries.pop(0)
+        dev = make()
+        self._entries.append((key, nbytes, dev))
+        return dev
 
 
 def make_predict_step(compiled) -> Callable:

@@ -138,10 +138,12 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
         self._addr = (host, int(port))
         self.timeout = timeout
 
-    def _connect_once(self) -> http.client.HTTPConnection:
+    def _connect_once(self, transfer_timeout: Optional[float] = None) -> http.client.HTTPConnection:
         conn = http.client.HTTPConnection(*self._addr, timeout=_CONNECT_TIMEOUT)
         conn.connect()  # fail the dial fast; transfers get the long budget
-        conn.sock.settimeout(self.timeout)
+        conn.sock.settimeout(
+            self.timeout if transfer_timeout is None else transfer_timeout
+        )
         return conn
 
     @staticmethod
@@ -196,10 +198,13 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
         self._post("/update", payload, "update_parameters")
 
     def health(self) -> bool:
-        """One non-retried probe of ``GET /health`` (liveness check)."""
+        """One non-retried probe of ``GET /health``, bounded end-to-end by
+        ``_CONNECT_TIMEOUT`` (a wedged-but-accepting server must not stall
+        the liveness check for the full transfer budget)."""
         try:
             return self._roundtrip(
-                self._connect_once(), "GET", "/health", None
+                self._connect_once(transfer_timeout=_CONNECT_TIMEOUT),
+                "GET", "/health", None,
             ) == b"ok"
         except Exception:
             return False

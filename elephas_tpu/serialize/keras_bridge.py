@@ -173,10 +173,12 @@ def _schedule_from_keras(schedule) -> Optional[dict]:
         return {
             "schedule": "piecewise_constant",
             "init_value": values[0],
-            # optax piecewise_constant multiplies by scale at each
-            # boundary: scale_i = values[i+1]/values[i]
+            # optax piecewise_constant multiplies by scale_i =
+            # values[i+1]/values[i] at count >= boundary, while Keras
+            # keeps the OLD value at step == boundary — shift each
+            # boundary by +1 so fn(boundary) matches Keras exactly.
             "boundaries_and_scales": {
-                int(b): float(values[i + 1] / values[i])
+                int(b) + 1: float(values[i + 1] / values[i])
                 for i, b in enumerate(bounds)
             },
         }

@@ -1,6 +1,6 @@
 """Units for the shared eval helpers in ``engine/step.py``:
 ``weighted_mean_over_chunks`` (exact weighted metric mean, reference
-§3.5 semantics) and ``DeviceEvalCache`` (one-slot identity-keyed device
+§3.5 semantics) and ``DeviceEvalCache`` (small identity-keyed LRU device
 cache with a size bound — serving repeated per-epoch validation without
 per-epoch re-uploads, and streaming for oversized sets)."""
 
@@ -61,6 +61,68 @@ def test_device_eval_cache_scalar_key_participates():
     cache.get((a, 8), a.nbytes, lambda: builds.append(1))
     cache.get((a, 12), a.nbytes, lambda: builds.append(1))  # usable changed
     assert len(builds) == 2
+
+
+def test_device_eval_cache_alternating_sets_both_stay_resident():
+    """Two validation sets used alternately (estimator split + manual
+    evaluate) must each upload exactly once — the r3 one-slot cache
+    thrashed silently on this pattern."""
+    cache = DeviceEvalCache()
+    a, b = np.zeros(4), np.ones(4)
+    builds = []
+
+    def make_for(tag):
+        def make():
+            builds.append(tag)
+            return tag
+
+        return make
+
+    for _ in range(3):
+        assert cache.get((a,), a.nbytes, make_for("A")) == "A"
+        assert cache.get((b,), b.nbytes, make_for("B")) == "B"
+    assert builds == ["A", "B"]
+
+
+def test_device_eval_cache_evicts_least_recently_used():
+    cache = DeviceEvalCache(slots=2)
+    arrs = [np.full(4, i) for i in range(3)]
+    builds = []
+
+    def make_for(i):
+        def make():
+            builds.append(i)
+            return i
+
+        return make
+
+    cache.get((arrs[0],), 4, make_for(0))
+    cache.get((arrs[1],), 4, make_for(1))
+    cache.get((arrs[0],), 4, make_for(0))  # refresh 0 → 1 is now LRU
+    cache.get((arrs[2],), 4, make_for(2))  # evicts 1
+    assert cache.get((arrs[0],), 4, make_for(0)) == 0  # still cached
+    cache.get((arrs[1],), 4, make_for(1))  # rebuilds
+    assert builds == [0, 1, 2, 1]
+
+
+def test_device_eval_cache_total_bytes_bounded_before_upload(monkeypatch):
+    """Cached entries together never exceed the byte budget, and eviction
+    happens BEFORE the new set builds (peak pinned memory == budget)."""
+    monkeypatch.setattr(step_mod, "_EVAL_CACHE_MAX_BYTES", 100)
+    cache = DeviceEvalCache(slots=4)
+    a, b = np.zeros(60, dtype=np.uint8), np.zeros(60, dtype=np.uint8)
+
+    def make_checking_budget(tag):
+        def make():
+            held = sum(e[1] for e in cache._entries)
+            assert held + 60 <= 100, "evicted after upload, not before"
+            return tag
+
+        return make
+
+    assert cache.get((a,), 60, make_checking_budget("A")) == "A"
+    assert cache.get((b,), 60, make_checking_budget("B")) == "B"  # evicts A
+    assert [e[2] for e in cache._entries] == ["B"]
 
 
 def test_device_eval_cache_declines_oversized_sets(monkeypatch):

@@ -145,6 +145,26 @@ def test_health_probe_reflects_server_liveness():
             client.close()
 
 
+def test_health_probe_bounded_on_wedged_server():
+    """A server that ACCEPTS connections but never responds (wedged) must
+    not stall ``health()`` for the 60s transfer budget — the probe is
+    bounded end-to-end by the short connect timeout."""
+    import socket as socket_mod
+
+    from elephas_tpu.parameter.client import HttpClient
+
+    wedge = socket_mod.socket()
+    wedge.bind(("127.0.0.1", 0))
+    wedge.listen(4)
+    try:
+        client = HttpClient("127.0.0.1:%d" % wedge.getsockname()[1])
+        t0 = time.monotonic()
+        assert client.health() is False
+        assert time.monotonic() - t0 < 6, "wedged server stalled the probe"
+    finally:
+        wedge.close()
+
+
 def test_ps_death_mid_async_fit_fails_fast(monkeypatch):
     """Stop the parameter server mid-async-fit: every worker's next wire op
     must raise ``ParameterServerUnavailable`` after its short retry budget,

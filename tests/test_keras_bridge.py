@@ -201,6 +201,20 @@ def test_keras_lr_schedules_map_to_optax():
     fn = resolve_schedule(cfg["learning_rate"])
     np.testing.assert_allclose(float(fn(150)), 0.01, rtol=1e-5)
     np.testing.assert_allclose(float(fn(250)), 0.001, rtol=1e-5)
+    # AT each boundary Keras keeps the OLD value (switch happens at
+    # boundary+1) — probe both sides exactly against Keras itself.
+    for step in (99, 100, 101, 200, 201):
+        np.testing.assert_allclose(
+            float(fn(step)), float(pw(step)), rtol=1e-5,
+            err_msg=f"piecewise mismatch vs Keras at step {step}",
+        )
+
+
+def test_dict_lr_without_schedule_key_raises_value_error():
+    from elephas_tpu.api.compile import resolve_schedule
+
+    with pytest.raises(ValueError, match="schedule"):
+        resolve_schedule({"init_value": 0.1})
 
 
 def test_schedule_config_trains_and_serializes(tmp_path):
