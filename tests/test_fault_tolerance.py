@@ -165,6 +165,102 @@ def test_health_probe_bounded_on_wedged_server():
         wedge.close()
 
 
+def _retry_trainer(lock=True, max_failures=4, frequency="epoch"):
+    """AsyncTrainer on 2 virtual devices with a tiny MLP — shared by the
+    worker-retry tests (VERDICT r3 #2, the ``spark.task.maxFailures``
+    analogue)."""
+    from elephas_tpu import compile_model
+    from elephas_tpu.data.rdd import ShardedDataset
+    from elephas_tpu.engine.async_engine import AsyncTrainer
+    from elephas_tpu.models import get_model
+    from elephas_tpu.parallel.mesh import build_mesh
+
+    x, y = make_blobs(n=256, num_classes=3, dim=8, seed=3)
+    net = compile_model(
+        get_model("mlp", features=(16,), num_classes=3),
+        optimizer={"name": "sgd", "learning_rate": 0.05},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(8,),
+        seed=0,
+    )
+    trainer = AsyncTrainer(
+        net, build_mesh(num_data=2), frequency=frequency, lock=lock,
+        max_failures=max_failures,
+    )
+    return trainer, ShardedDataset(x, y, 2)
+
+
+def test_transient_worker_fault_retries_and_completes():
+    """One worker's epoch unit raises ONCE: the fit must complete (the
+    unit retries from a fresh PS pull) and record the retry in history
+    as ``worker_retries`` — Spark would re-run the failed task the same
+    way (SURVEY.md §5.3)."""
+    trainer, dataset = _retry_trainer(max_failures=4)
+    real_epoch_fn = trainer._epoch_fn
+    fails = {"left": 1}
+    gate = threading.Lock()
+
+    def flaky_epoch_fn(state, xb, yb):
+        with gate:  # exactly-once across the racing worker threads
+            inject = fails["left"] > 0
+            if inject:
+                fails["left"] -= 1
+        if inject:
+            raise RuntimeError("injected transient worker fault")
+        return real_epoch_fn(state, xb, yb)
+
+    trainer._epoch_fn = flaky_epoch_fn
+    state, history = trainer.fit(dataset, epochs=3, batch_size=16)
+    assert fails["left"] == 0, "fault was never injected"
+    assert history["worker_retries"] == [1, 0, 0]
+    assert len(history["loss"]) == 3
+    assert history["acc"][-1] > 0.6  # training proceeded past the fault
+
+
+def test_transient_batch_fault_retries_at_batch_granularity():
+    """frequency='batch': the retry unit is ONE batch, so a single flaky
+    step costs one re-pull, not a whole epoch."""
+    trainer, dataset = _retry_trainer(max_failures=3, frequency="batch")
+    real_step_fn = trainer._step_fn
+    fails = {"left": 1}
+    gate = threading.Lock()
+
+    def flaky_step_fn(state, xb, yb):
+        with gate:  # exactly-once across the racing worker threads
+            inject = fails["left"] > 0
+            if inject:
+                fails["left"] -= 1
+        if inject:
+            raise RuntimeError("injected transient batch fault")
+        return real_step_fn(state, xb, yb)
+
+    trainer._step_fn = flaky_step_fn
+    state, history = trainer.fit(dataset, epochs=2, batch_size=32)
+    assert fails["left"] == 0
+    assert history["worker_retries"] == [1, 0]
+    assert len(history["loss"]) == 2
+
+
+def test_hard_worker_fault_fails_after_max_failures():
+    """A unit that ALWAYS raises must exhaust exactly ``max_failures``
+    attempts and then fail the fit with the original exception."""
+    trainer, dataset = _retry_trainer(max_failures=3)
+    attempts = {"n": 0}
+
+    def broken_epoch_fn(state, xb, yb):
+        attempts["n"] += 1
+        raise RuntimeError("permanent worker fault")
+
+    trainer._epoch_fn = broken_epoch_fn
+    with pytest.raises(RuntimeError, match="permanent worker fault"):
+        trainer.fit(dataset, epochs=2, batch_size=16)
+    # One worker hits the budget and fails the fit; the other worker's
+    # attempts are its own budget at most.
+    assert attempts["n"] >= 3
+    assert attempts["n"] <= 6
+
+
 def test_ps_death_mid_async_fit_fails_fast(monkeypatch):
     """Stop the parameter server mid-async-fit: every worker's next wire op
     must raise ``ParameterServerUnavailable`` after its short retry budget,
