@@ -12,8 +12,12 @@ a plain callable. Trials stay embarrassingly parallel with *independent
 per-worker streams* (the reference's exact semantic, including its
 limitation — documented, not "fixed"): one host thread per chip, each
 thread pinning its trials to its device via ``jax.default_device``. On
-multi-host pods each host runs its own ``HyperParamModel`` over its
-local chips (SURVEY.md §7 step 6).
+multi-host pods every host runs the same ``minimize`` call over its
+LOCAL chips; ``max_evals`` splits across the job's global worker slots,
+per-host bests are gathered over the DCN control plane, and every host
+returns the identical global argmin — the reference's driver-side
+``collect()`` + argmin (SURVEY.md §3.4), with the DCN allgather playing
+the collect.
 
 Objective contract (hyperopt-compatible):
     ``model_fn(sample: dict, data) -> {"loss": float, "model": CompiledModel,
@@ -23,6 +27,7 @@ Objective contract (hyperopt-compatible):
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
@@ -256,9 +261,14 @@ class HyperParamModel:
 
     def __init__(self, sc=None, num_workers: Optional[int] = None):
         del sc
-        n_devices = len(jax.devices())
+        # LOCAL worker count: one thread per addressable chip. Multi-host,
+        # every host runs the same minimize() over its own chips and the
+        # job-wide reduction happens over DCN (see minimize).
+        n_devices = len(jax.local_devices())
         self.num_workers = min(num_workers or n_devices, n_devices)
         self.best_models: List[Dict] = []  # per-worker bests (reference attr)
+        self.trials: List[Dict] = []  # every LOCAL trial of the last minimize
+        self._last_best: Optional[Dict] = None  # returned best (global, multi-host)
 
     def minimize(
         self,
@@ -277,42 +287,78 @@ class HyperParamModel:
         trial (the reference's hyperas ``data`` function).
         ``algo``: ``'tpe'`` (default — within-worker adaptive, matching
         the reference's per-executor ``hyperopt.fmin``) or ``'random'``.
+
+        Multi-host (pod): every host calls this with the same arguments
+        (SPMD control flow — the allgather below is a collective).
+        ``max_evals`` splits across the job's global worker slots so
+        exactly ``max_evals`` trials run job-wide; each host's best is
+        gathered over DCN and every host returns the identical global
+        argmin, the winner's model rebuilt from its serialized payload
+        where possible. Per-trial wall times ride each result as
+        ``t_start``/``t_end``/``secs`` (``time.perf_counter``) for
+        steady-state throughput accounting.
         """
         if space is None:
             space = {}
         if algo not in _SAMPLERS:
             raise ValueError(f"algo must be one of {sorted(_SAMPLERS)}, got {algo!r}")
         dataset = data() if callable(data) else data
-        # Exactly max_evals trials total: worker i takes the remainder's
-        # i-th extra trial (idle workers get zero).
-        base, extra = divmod(max_evals, self.num_workers)
-        trials_for = [base + (1 if i < extra else 0) for i in range(self.num_workers)]
-        devices = jax.devices()[: self.num_workers]
+        n_hosts = jax.process_count()
+        pid = jax.process_index()
+        multi_host = n_hosts > 1
+        # Global worker slots. Hosts can expose unequal chip counts, so
+        # the split is computed over GATHERED local counts — exactly
+        # max_evals trials job-wide, the trailing slots absorbing the
+        # remainder (idle slots get zero, like the reference's idle
+        # executors).
+        if multi_host:
+            from jax.experimental import multihost_utils
+
+            counts = np.asarray(
+                multihost_utils.process_allgather(
+                    np.array([self.num_workers], dtype=np.int64)
+                )
+            ).reshape(-1)
+            total_workers = int(counts.sum())
+            offset = int(counts[:pid].sum())
+        else:
+            total_workers = self.num_workers
+            offset = 0
+        base, extra = divmod(max_evals, total_workers)
+        trials_for = [base + (1 if g < extra else 0) for g in range(total_workers)]
+        devices = jax.local_devices()[: self.num_workers]
         results: List[List[Dict]] = [[] for _ in range(self.num_workers)]
         errors: List[BaseException] = []
 
         def worker(index: int, device) -> None:
-            # Independent stream per worker — the reference's independent
-            # Trials() semantics (§3.4 note); the sampler is adaptive only
-            # *within* this worker, exactly like per-executor fmin.
-            # SeedSequence spawning: collision-free across (seed, worker)
-            # pairs, unlike arithmetic seed mixing.
-            rng = np.random.default_rng([seed, index])
+            # Independent stream per GLOBAL worker slot — the reference's
+            # independent Trials() semantics (§3.4 note); the sampler is
+            # adaptive only *within* this worker, exactly like
+            # per-executor fmin. SeedSequence spawning: collision-free
+            # across (seed, slot) pairs — including across hosts —
+            # unlike arithmetic seed mixing.
+            g = offset + index
+            rng = np.random.default_rng([seed, g])
             sampler = _SAMPLERS[algo](space, rng)
             _trial_ctx.device = device  # thread-local; see current_trial_device
             try:
                 with jax.default_device(device):
-                    for trial in range(trials_for[index]):
+                    for trial in range(trials_for[g]):
                         values, sample = sampler.suggest()
+                        t0 = time.perf_counter()
                         out = model(sample, dataset)
+                        t1 = time.perf_counter()
                         if not isinstance(out, dict) or "loss" not in out:
                             raise TypeError(
                                 "objective must return a dict with a 'loss' key"
                             )
                         out.setdefault("status", "ok")
                         out["sample"] = sample
-                        out["worker"] = index
+                        out["worker"] = g
                         out["trial"] = trial
+                        out["t_start"] = t0
+                        out["t_end"] = t1
+                        out["secs"] = t1 - t0
                         results[index].append(out)
                         sampler.observe(values, float(out["loss"]))
             except BaseException as exc:
@@ -326,21 +372,101 @@ class HyperParamModel:
             t.start()
         for t in threads:
             t.join()
-        if errors:
+        if errors and not multi_host:
             raise errors[0]
 
+        self.trials = [t for worker_results in results for t in worker_results]
         self.best_models = [
             min(worker_results, key=lambda r: r["loss"])
             for worker_results in results
             if worker_results
         ]
-        if not self.best_models:
-            raise RuntimeError("no trials completed")
-        return min(self.best_models, key=lambda r: r["loss"])
+        local_best = (
+            min(self.best_models, key=lambda r: r["loss"])
+            if self.best_models
+            else None
+        )
+        if not multi_host:
+            if local_best is None:
+                raise RuntimeError("no trials completed")
+            self._last_best = local_best
+            return local_best
+        # The allgather is a COLLECTIVE: a host that raised before it
+        # would park every peer inside process_allgather with no bounded
+        # failure path (the async engine's PS barriers exist for the same
+        # reason). So even a host whose workers errored contributes what
+        # it has (possibly nothing), completes the collective, and THEN
+        # re-raises locally — peers finish with the surviving trials.
+        best = self._global_argmin(local_best, pid)
+        if errors:
+            raise errors[0]
+        self._last_best = best
+        return best
+
+    def _global_argmin(self, local_best: Optional[Dict], pid: int) -> Dict:
+        """Reference §3.4's driver ``collect()`` + argmin, over DCN: gather
+        every host's best (a collective — every host must call this), pick
+        the global argmin with a deterministic (loss, host) tie-break, and
+        rebuild the winner's model locally where it was serializable."""
+        import pickle
+
+        from elephas_tpu.parallel import distributed
+
+        payload = None
+        if local_best is not None:
+            summary = {k: v for k, v in local_best.items() if k != "model"}
+            model_payload = None
+            model_obj = local_best.get("model")
+            if model_obj is not None:
+                try:
+                    from elephas_tpu.serialize.serialization import model_to_dict
+
+                    model_payload = model_to_dict(model_obj)
+                except Exception:
+                    model_payload = None  # winner's host keeps the live object
+            try:
+                payload = pickle.dumps(
+                    {"host": pid, "summary": summary, "model_payload": model_payload}
+                )
+            except Exception:
+                payload = pickle.dumps(
+                    {
+                        "host": pid,
+                        "summary": {
+                            "loss": float(local_best["loss"]),
+                            "sample": local_best.get("sample"),
+                            "worker": local_best.get("worker"),
+                            "trial": local_best.get("trial"),
+                            "status": local_best.get("status", "ok"),
+                        },
+                        "model_payload": model_payload,
+                    }
+                )
+        gathered = distributed.allgather_bytes(
+            payload if payload is not None else pickle.dumps(None)
+        )
+        candidates = [c for c in (pickle.loads(b) for b in gathered) if c is not None]
+        if not candidates:
+            raise RuntimeError("no trials completed job-wide")
+        win = min(candidates, key=lambda c: (c["summary"]["loss"], c["host"]))
+        if win["host"] == pid and local_best is not None:
+            return local_best  # the live trial dict, model object included
+        best = dict(win["summary"])
+        if win["model_payload"] is not None:
+            from elephas_tpu.serialize.serialization import dict_to_model
+
+            best["model"] = dict_to_model(win["model_payload"])
+        return best
 
     def best_model(self):
-        """Best model object across workers (reference convenience)."""
-        if not self.best_models:
-            raise RuntimeError("call minimize() first")
-        best = min(self.best_models, key=lambda r: r["loss"])
+        """Best model object across workers — job-wide after a multi-host
+        ``minimize`` (reference convenience)."""
+        best = getattr(self, "_last_best", None)
+        if best is None:
+            # A rank whose global slots got zero trials still holds the
+            # gathered winner in _last_best; best_models alone can't tell
+            # "never minimized" from "idle rank".
+            if not self.best_models:
+                raise RuntimeError("call minimize() first")
+            best = min(self.best_models, key=lambda r: r["loss"])
         return best.get("model")

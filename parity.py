@@ -282,7 +282,9 @@ def hyperparam_search(quick: bool):
         return {"loss": float(val["loss"]), "val_acc": float(val["acc"])}
 
     model = HyperParamModel(None)
-    max_evals = 2 if quick else 6
+    # 8 full-run trials: with 3 width choices, ≥4 land on repeat shapes,
+    # giving the steady-state window a real sample (see below).
+    max_evals = 2 if quick else 8
     t0 = time.perf_counter()
     best = model.minimize(
         objective,
@@ -292,11 +294,36 @@ def hyperparam_search(quick: bool):
     )
     secs = time.perf_counter() - t0
     history = {"val_acc": [best["val_acc"]]}
+    epochs_per_trial = 1 if quick else 2
     rec = _record(
         "hyperparam_search", "trial-parallel", history, n * max_evals,
-        1 if quick else 2, secs, real,
+        epochs_per_trial, secs, real,
         extra={"best_sample": best["sample"], "trials": max_evals},
     )
+    # Steady-state trial throughput (VERDICT r3 #5, closing r2 weak #1's
+    # last row): a trial pays full XLA compilation the first time its
+    # worker sees a given model SHAPE (the width node) — measured ~12s
+    # for a fresh width vs ~4s for a repeat even at a new lr — so the
+    # comparable rate excludes each worker's first occurrence of each
+    # width (which subsumes the first trial). Per-trial timestamps come
+    # from HyperParamModel itself.
+    seen_shapes = set()
+    steady = []
+    for t in sorted(model.trials, key=lambda t: (t["worker"], t["trial"])):
+        key = (t["worker"], t["sample"]["width"])
+        if key in seen_shapes:
+            steady.append(t)
+        else:
+            seen_shapes.add(key)
+    if steady:
+        span = max(t["t_end"] for t in steady) - min(t["t_start"] for t in steady)
+        rec["samples_per_sec"] = round(
+            n * epochs_per_trial * len(steady) / span, 2
+        )
+        rec["timing"] = "steady_state"
+        rec["trials_per_sec_steady"] = round(len(steady) / span, 4)
+        rec["steady_trials"] = len(steady)
+        rec["warmup_trials"] = len(model.trials) - len(steady)
     return rec
 
 

@@ -129,6 +129,179 @@ def test_two_process_training_all_modes(tmp_path, mode, ps_mode):
     assert results[0]["val_loss"] == results[1]["val_loss"]
 
 
+_HYPERPARAM_CHILD = """
+import os, sys
+idx, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=idx)
+
+import json
+import numpy as np
+from elephas_tpu import compile_model
+from elephas_tpu.hyperparam import HyperParamModel, hp
+from elephas_tpu.models import get_model
+
+def objective(sample, data):
+    # Deterministic in the sample: the job-wide argmin is well-defined
+    # and checkable from the trial logs alone.
+    loss = float((np.log(sample["lr"]) - np.log(3e-3)) ** 2 + 0.1 * sample["width"])
+    net = compile_model(
+        get_model("mlp", features=(4,), num_classes=2),
+        optimizer={"name": "sgd", "learning_rate": sample["lr"]},
+        loss="categorical_crossentropy",
+        input_shape=(3,),
+        seed=idx,
+    )
+    return {"loss": loss, "model": net}
+
+search = HyperParamModel(None, num_workers=2)
+best = search.minimize(
+    objective, lambda: None, max_evals=6,
+    space={"lr": hp.loguniform(np.log(1e-4), np.log(1e-2)), "width": hp.choice([0, 1])},
+    seed=7,
+)
+print("RESULT " + json.dumps({
+    "proc": idx,
+    "best_loss": best["loss"],
+    "best_sample": best["sample"],
+    "best_worker": best["worker"],
+    "has_model": best.get("model") is not None,
+    "local_trials": [
+        {"loss": t["loss"], "worker": t["worker"], "trial": t["trial"]}
+        for t in search.trials
+    ],
+}))
+"""
+
+
+def test_two_process_hyperparam_global_best(tmp_path):
+    """Pod-scale hyperparam (VERDICT r3 #3): max_evals splits across the
+    job's global worker slots (exactly max_evals trials job-wide), and
+    both ranks return the IDENTICAL global best — the reference driver's
+    collect()+argmin (SURVEY.md §3.4) played by a DCN allgather. The
+    winner's model is rebuilt on the other host from its serialized
+    payload."""
+    script = tmp_path / "child.py"
+    script.write_text(_HYPERPARAM_CHILD)
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", coord],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"child failed:\n{out}\n{err[-3000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                rec = json.loads(line[len("RESULT "):])
+                results[rec["proc"]] = rec
+    assert set(results) == {0, 1}
+    # Identical global best on every rank, model object included.
+    assert results[0]["best_loss"] == results[1]["best_loss"]
+    assert results[0]["best_sample"] == results[1]["best_sample"]
+    assert results[0]["best_worker"] == results[1]["best_worker"]
+    assert results[0]["has_model"] and results[1]["has_model"]
+    # Exactly max_evals trials ran job-wide, split over 4 global slots
+    # (2 hosts x 2 local workers), and disjoint slots per host.
+    all_trials = results[0]["local_trials"] + results[1]["local_trials"]
+    assert len(all_trials) == 6
+    assert {t["worker"] for t in results[0]["local_trials"]} == {0, 1}
+    assert {t["worker"] for t in results[1]["local_trials"]} == {2, 3}
+    # The returned best IS the job-wide argmin of every trial that ran.
+    assert results[0]["best_loss"] == min(t["loss"] for t in all_trials)
+
+
+_HYPERPARAM_EDGE_CHILD = """
+import os, sys
+idx, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=idx)
+
+import json
+import numpy as np
+from elephas_tpu.hyperparam import HyperParamModel, hp
+
+space = {"lr": hp.loguniform(np.log(1e-4), np.log(1e-2))}
+
+# 1. idle rank: max_evals=1 < global slots, so host 1 runs ZERO trials
+#    but must still return the global best and serve best_model().
+search = HyperParamModel(None, num_workers=2)
+best = search.minimize(
+    lambda s, d: {"loss": float(s["lr"])}, lambda: None, max_evals=1,
+    space=space, seed=1,
+)
+idle_ok = search.best_model() is None  # objective returns no model: None, no raise
+
+# 2. one host's objective raises: the failing host must still complete
+#    the gather collective (no peer hang), then re-raise; the healthy
+#    host finishes with the surviving trials.
+def flaky(sample, data):
+    if idx == 1:
+        raise RuntimeError("injected trial fault on host 1")
+    return {"loss": float(sample["lr"])}
+
+search2 = HyperParamModel(None, num_workers=2)
+try:
+    best2 = search2.minimize(flaky, lambda: None, max_evals=4, space=space, seed=2)
+    outcome = {"ok": True, "loss": best2["loss"]}
+except RuntimeError as exc:
+    outcome = {"ok": False, "err": str(exc)}
+
+print("RESULT " + json.dumps({
+    "proc": idx, "best_loss": best["loss"], "n_trials": len(search.trials),
+    "idle_ok": idle_ok, "outcome": outcome,
+}))
+"""
+
+
+def test_two_process_hyperparam_idle_rank_and_trial_fault(tmp_path):
+    """Edge semantics of the pod-scale gather: a rank with zero trial
+    slots still returns the global best (and best_model() works), and a
+    host whose objective raises completes the collective before
+    re-raising so the healthy peer never hangs."""
+    script = tmp_path / "child.py"
+    script.write_text(_HYPERPARAM_EDGE_CHILD)
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", coord],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"child failed:\n{out}\n{err[-3000:]}"
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                rec = json.loads(line[len("RESULT "):])
+                results[rec["proc"]] = rec
+    assert set(results) == {0, 1}
+    # Idle rank: host 1 ran nothing yet returns host 0's single trial.
+    assert results[1]["n_trials"] == 0 and results[0]["n_trials"] == 1
+    assert results[0]["best_loss"] == results[1]["best_loss"]
+    assert results[0]["idle_ok"] and results[1]["idle_ok"]
+    # Trial fault: host 0 completes on surviving trials; host 1 re-raises
+    # AFTER the collective (both processes exited 0 — no hang).
+    assert results[0]["outcome"]["ok"] is True
+    assert results[1]["outcome"] == {"ok": False, "err": "injected trial fault on host 1"}
+
+
 def test_peer_host_death_surfaces_as_barrier_timeout(tmp_path):
     """Kill host 1 mid-async-fit: host 0 must fail with wait_barrier's
     TimeoutError within the configured budget instead of hanging — the
