@@ -280,6 +280,12 @@ class TpuModel:
             )
             self._sync_trainer = None
 
+        # Worker-barrier epoch timestamps (async/hogwild): the true
+        # training cadence for throughput harnesses — epoch callbacks run
+        # in an overlapped drainer thread there and lag by the in-flight
+        # fire. None in sync mode, where callbacks are in-loop.
+        self.last_epoch_end_times = getattr(trainer, "epoch_end_times", None)
+
         # Checkpoint saves run async during training; barrier before fit
         # returns so snapshots are durable when the caller sees the result.
         for cb in callbacks:
@@ -290,6 +296,11 @@ class TpuModel:
         # Fold the trained weights back into the master network
         # (reference: master_network.set_weights after collect/PS stop).
         self._state = state
+        # Async/hogwild leave state leaves COMMITTED to the PS/worker
+        # devices; the SPMD evaluator must be free to re-place them
+        # (predict after an async fit would otherwise fail on mixed
+        # device commitments). Stripped lazily on first predict/evaluate.
+        self._state_committed = self.mode != "synchronous"
         self._master.params = jax.device_get(state.params)
         self._master.batch_stats = jax.device_get(state.batch_stats)
         self.training_histories.append(history)
@@ -307,6 +318,11 @@ class TpuModel:
     def _current_state(self):
         if self._state is None:
             self._state = init_train_state(self._master)
+        elif getattr(self, "_state_committed", False):
+            # One host fetch, then cached: uncommitted numpy leaves let
+            # the jitted SPMD evaluator shard/replicate freely.
+            self._state = jax.device_get(self._state)
+            self._state_committed = False
         return self._state
 
     def predict(self, data, batch_size: int = 256) -> np.ndarray:

@@ -22,6 +22,7 @@ reference, where the driver averages weights, never optimizer slots).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
@@ -37,6 +38,15 @@ from elephas_tpu.parameter.server import make_server
 from elephas_tpu.utils.functional_utils import subtract_params
 
 _FREQUENCIES = ("batch", "epoch")
+
+
+@jax.jit
+def _probe_sum(leaves):
+    """Scalar depending on every leaf — fetching it forces them all with
+    a single device round-trip (phase-profiling helper)."""
+    return sum(
+        jnp.reshape(leaf, (-1,))[0].astype(jnp.float32) for leaf in leaves
+    )
 
 
 class AsyncTrainer:
@@ -78,6 +88,14 @@ class AsyncTrainer:
         self.port = port
         self.granularity = granularity
         self.max_failures = max_failures
+        # Phase profiling (scripts/flagship_phases.py): when True, the
+        # 'epoch'-frequency worker loop and the epoch fire force device
+        # results at phase boundaries and append per-phase wall seconds
+        # to phase_times. Forcing breaks the dispatch pipeline, so this
+        # measures PHASE COSTS, not end-to-end throughput — leave False
+        # for real runs.
+        self.profile_phases = False
+        self.phase_times: Dict[str, List[float]] = {}
         # One worker per device along the data axis. Under multi-host SPMD
         # every process constructs the same global mesh but drives only its
         # *addressable* devices; the partition index stays global so shard g
@@ -133,14 +151,22 @@ class AsyncTrainer:
         if usable < n:
             spans.append((usable, n))
 
-        def eval_chunk(start, stop):
+        # Dispatch every chunk, then ONE device_get for all their metric
+        # dicts: a fetch per chunk costs a tunnel round-trip each (~0.1s
+        # here), which made the overlapped epoch fire eval-RTT-bound.
+        device_metrics = []
+        for start, stop in spans:
             if cached is not None:
                 x, y = cached[0][start:stop], cached[1][start:stop]
             else:
                 x, y = jnp.asarray(features[start:stop]), jnp.asarray(labels[start:stop])
-            return jax.device_get(self._local_eval_fn(state, x, y))
-
-        return weighted_mean_over_chunks(spans, eval_chunk, n)
+            device_metrics.append(self._local_eval_fn(state, x, y))
+        fetched = jax.device_get(device_metrics)
+        return weighted_mean_over_chunks(
+            [(s, e, i) for i, (s, e) in enumerate(spans)],
+            lambda start, stop, i: fetched[i],
+            n,
+        )
 
     # -------------------------------------------------------------------------
 
@@ -176,6 +202,13 @@ class AsyncTrainer:
         server = None
         remote_client_factory = None
         if not multi_host:
+            import os
+
+            # Single-host default is loopback + no auth, but a user who
+            # binds beyond loopback (ELEPHAS_PS_BIND) and configures a
+            # key must get an AUTHENTICATED server — silently ignoring
+            # the key would leave an open pickle endpoint.
+            env_key = os.environ.get("ELEPHAS_PS_AUTH_KEY")
             server = make_server(
                 self.parameter_server_mode,
                 store0,
@@ -183,6 +216,7 @@ class AsyncTrainer:
                 port=self.port,
                 device=jax.local_devices()[0],
                 granularity=self.granularity,
+                auth_key=bytes.fromhex(env_key) if env_key else None,
             )
             server.start()
         else:
@@ -191,6 +225,21 @@ class AsyncTrainer:
             from elephas_tpu.parallel import distributed
             from elephas_tpu.parameter.client import make_client
             from elephas_tpu.utils.sockets import determine_master
+
+            # Wire auth, ON by default across hosts: the PS binds beyond
+            # loopback and speaks pickle, so every http/socket message
+            # carries an HMAC-SHA256 tag under a per-fit secret that host
+            # 0 generates and broadcasts over the DCN control plane (the
+            # same trusted channel that carries the PS address). Override
+            # the key with $ELEPHAS_PS_AUTH_KEY (hex) for an external PS;
+            # opt out with ELEPHAS_PS_AUTH=off.
+            auth_key = None
+            auth_on = os.environ.get("ELEPHAS_PS_AUTH", "on").lower() not in (
+                "off", "0", "false",
+            )
+            if auth_on and distributed.is_host0():
+                env_key = os.environ.get("ELEPHAS_PS_AUTH_KEY")
+                auth_key = bytes.fromhex(env_key) if env_key else os.urandom(32)
 
             if distributed.is_host0():
                 server = make_server(
@@ -201,6 +250,7 @@ class AsyncTrainer:
                     device=jax.local_devices()[0],
                     host=os.environ.get("ELEPHAS_PS_BIND", "0.0.0.0"),
                     granularity=self.granularity,
+                    auth_key=auth_key,
                 )
                 server.start()
             if server is not None:
@@ -216,12 +266,20 @@ class AsyncTrainer:
             address = os.environ.get(
                 "ELEPHAS_PS_ADDRESS"
             ) or distributed.broadcast_from_host0(advertised)
+            if auth_on:
+                auth_key = (
+                    distributed.broadcast_bytes_from_host0(auth_key or b"") or None
+                )
             remote_client_factory = lambda: make_client(  # noqa: E731
-                self.parameter_server_mode, address
+                self.parameter_server_mode, address, auth_key=auth_key
             )
 
         per_worker_metrics: List[List[Dict[str, float]]] = [None] * self.n_workers
         errors: List[BaseException] = []
+        # True training cadence: wall timestamp when the SLOWEST worker
+        # finishes each epoch (the fire timestamps lag by the in-flight
+        # fire, so throughput harnesses should read these).
+        self.epoch_end_times: List[float] = []
         # Epoch-barrier bookkeeping: once the *slowest* worker has finished
         # epoch e (workers never block on each other — the barrier is
         # observational only), fire callbacks and evaluate validation on a
@@ -255,9 +313,10 @@ class AsyncTrainer:
         do_val = validation_data is not None and is_driver
         epoch_done_counts = [0] * epochs
         epochs_fired = 0
-        barrier_lock = threading.Lock()
-        fire_lock = threading.Lock()  # serializes barrier work (snapshot/val/callbacks)
+        fire_cond = threading.Condition()
         fire_queue: deque = deque()
+        fire_stop = [False]
+        fire_errors: List[BaseException] = []
         val_records: List[Optional[Dict[str, float]]] = [None] * epochs
 
         def pull_snapshot():
@@ -271,8 +330,13 @@ class AsyncTrainer:
 
         snap_opt_state = [None]  # built once; identical zeros every fire
 
-        def do_fire(fire: int) -> None:
-            snapshot = pull_snapshot()
+        mark_phase = self._mark_phase
+
+        def do_fire(fire: int, snapshot=None) -> None:
+            t0 = time.perf_counter()
+            if snapshot is None:
+                snapshot = pull_snapshot()
+            mark_phase("fire_snapshot", t0, snapshot["params"])
             if snap_opt_state[0] is None:
                 snap_opt_state[0] = compiled.init_opt_state(snapshot["params"])
             # step must advance per epoch or rotating checkpointers
@@ -291,41 +355,102 @@ class AsyncTrainer:
                 # single-host because the snapshot's arrays are committed
                 # to the PS device — feeding them to the SPMD evaluator
                 # would mix committed placements and fail under jit.
+                t0 = time.perf_counter()
                 val_records[fire] = self._local_evaluate(
                     snap_state, *validation_data
                 )
+                mark_phase("fire_val", t0)
+            t0 = time.perf_counter()
             for cb in run_callbacks:
                 cb(fire, snap_state, {})
+            mark_phase("fire_callbacks", t0)
 
         def on_epoch_done(epoch: int) -> None:
             nonlocal epochs_fired
             if not run_callbacks and not do_val:
                 return
-            with barrier_lock:
+            if fire_errors:
+                # Surface a failed fire (checkpoint/eval) at the next
+                # epoch boundary instead of training to completion first.
+                raise RuntimeError(
+                    "epoch-barrier work failed; aborting fit"
+                ) from fire_errors[0]
+            with fire_cond:
                 epoch_done_counts[epoch] += 1
                 while (
                     epochs_fired < epochs
                     and epoch_done_counts[epochs_fired] == self.n_workers
                 ):
-                    fire_queue.append(epochs_fired)
+                    # Snapshot AT THE EPOCH BOUNDARY (a device-to-device
+                    # copy, ~10ms) so per-epoch validation samples the PS
+                    # as of this epoch even though the eval itself runs
+                    # later in the drainer. If the drainer falls behind
+                    # (slow user callback), stop pinning snapshots and
+                    # let those fires pull at fire time — bounded HBM
+                    # over honesty in the already-degenerate case.
+                    snapshot = pull_snapshot() if len(fire_queue) < 3 else None
+                    fire_queue.append((epochs_fired, snapshot))
+                    self.epoch_end_times.append(time.perf_counter())
                     epochs_fired += 1
-            # Serial FIFO drain under fire_lock: at most one epoch's
-            # barrier work runs at a time, in epoch order — concurrent
-            # fires raced evaluator creation and Orbax saves are not
-            # thread-safe (advisor r2). Workers with nothing to drain
-            # return WITHOUT touching fire_lock, so an in-flight fire
-            # (snapshot + validation + checkpoint) never stalls the
-            # other workers' epoch boundaries.
+                fire_cond.notify_all()
+
+        def fire_drainer() -> None:
+            # Dedicated serial-FIFO consumer: at most one epoch's barrier
+            # work runs at a time, in epoch order — concurrent fires raced
+            # evaluator creation and Orbax saves are not thread-safe
+            # (advisor r2). Running it OFF the worker threads means an
+            # in-flight fire (snapshot + validation + checkpoint) overlaps
+            # the next epoch's training instead of blocking a worker's
+            # dispatch between epochs — measured 23.6k -> ~30k samples/sec
+            # steady on the flagship hogwild CIFAR config (PROFILE.md §5:
+            # the fire was the dominant per-epoch overhead phase).
             while True:
-                with barrier_lock:
+                with fire_cond:
+                    while not fire_queue and not fire_stop[0]:
+                        fire_cond.wait()
                     if not fire_queue:
-                        return
-                with fire_lock:
-                    with barrier_lock:
-                        if not fire_queue:
-                            return
-                        fire = fire_queue.popleft()
-                    do_fire(fire)
+                        return  # stopped and drained
+                    fire, snapshot = fire_queue.popleft()
+                try:
+                    do_fire(fire, snapshot)
+                except BaseException as exc:  # checked at epoch boundaries
+                    fire_errors.append(exc)
+                    return
+
+        drainer = None
+        if run_callbacks or do_val:
+            if do_val:
+                # Pre-compile the epoch evaluator (and upload the val set
+                # to its device cache) BEFORE training starts: the first
+                # fire otherwise stalls the drainer for the eval jit
+                # (~20s on this chip), queueing epochs' fires — pinned
+                # snapshots and a burst of stale validations.
+                warm = pull_snapshot()
+                # Seed the fires' shared opt_state here (they'd build the
+                # identical zeros on first fire anyway) and drop the warm
+                # snapshot right after — holding it in fit()'s locals
+                # would pin a model-sized copy in HBM for the whole run.
+                snap_opt_state[0] = compiled.init_opt_state(warm["params"])
+                self._local_evaluate(
+                    TrainState.create(
+                        params=warm["params"],
+                        opt_state=snap_opt_state[0],
+                        batch_stats=warm["batch_stats"],
+                        step=0,
+                    ),
+                    *validation_data,
+                )
+                del warm
+            drainer = threading.Thread(target=fire_drainer, daemon=True)
+            drainer.start()
+
+        def stop_drainer() -> None:
+            if drainer is None:
+                return
+            with fire_cond:
+                fire_stop[0] = True
+                fire_cond.notify_all()
+            drainer.join()
 
         def worker(slot: int, global_index: int, device: jax.Device) -> None:
             try:
@@ -349,14 +474,16 @@ class AsyncTrainer:
             t.start()
         for t in threads:
             t.join()
+        stop_drainer()  # drains any queued fires, then returns
 
-        if errors:
+        if errors or fire_errors:
             # Multi-host: raising here (instead of entering the global
             # barrier) fails this process fast; peers' barriers abort via
             # the launcher's job-level restart (SURVEY.md §5.3 delegation).
+            # A failed fire outranks the derived worker abort it caused.
             if server is not None:
                 server.stop()
-            raise errors[0]
+            raise (fire_errors or errors)[0]
 
         if multi_host:
             # PS-backed host barriers (not device collectives): async hosts
@@ -485,6 +612,25 @@ class AsyncTrainer:
 
     # -------------------------------------------------------------------------
 
+    def _mark_phase(self, phase: str, t0: float, *force) -> None:
+        """Profiling hook: record wall seconds for one phase, forcing the
+        given device values first so async dispatch can't hide the cost.
+        Forcing is ONE scalar fetch of a jitted first-element reduction
+        over all leaves: block_until_ready returns early on the tunneled
+        dev chip (verify skill: axon gotchas) and a fetch per leaf would
+        bill ~60 tunnel RTTs to the phase. No-op unless ``profile_phases``."""
+        if not self.profile_phases:
+            return
+        for obj in force:
+            leaves = tuple(
+                leaf
+                for leaf in jax.tree_util.tree_leaves(obj)
+                if hasattr(leaf, "ndim") and getattr(leaf, "size", 0)
+            )
+            if leaves:
+                jax.device_get(_probe_sum(leaves))
+        self.phase_times.setdefault(phase, []).append(time.perf_counter() - t0)
+
     def _run_worker(
         self,
         index: int,
@@ -603,15 +749,23 @@ class AsyncTrainer:
                     key = jax.random.fold_in(shuffle_base, epoch)
                     if attempt:  # re-seeded shuffle clears data-order faults
                         key = jax.random.fold_in(key, 10_000 + attempt)
+                    t0 = time.perf_counter()
                     ex_d, ey_d = reshuffle_fn(jax.device_put(key, device), x_d, y_d)
+                    self._mark_phase("reshuffle", t0, ex_d)
+                    t0 = time.perf_counter()
                     state = pull_state(global_step, attempt)
+                    self._mark_phase("pull", t0, state.params)
+                    t0 = time.perf_counter()
                     new_state, metrics = self._epoch_fn(state, ex_d, ey_d)
                     # Fetching metrics forces the whole epoch scan, so a
                     # device-side fault raises HERE (retryable) before the
                     # delta is pushed — a poisoned delta must never reach
                     # the shared buffer.
                     fetched = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    self._mark_phase("train", t0, new_state.params)
+                    t0 = time.perf_counter()
                     push_delta(state, new_state)
+                    self._mark_phase("push", t0)
                     opt_state = new_state.opt_state
                     return fetched
 

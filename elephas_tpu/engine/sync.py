@@ -85,7 +85,12 @@ class SyncTrainer:
         # Jitted once here: wrapping per call would discard the trace cache
         # and retrace every epoch under validation_data (VERDICT r1 weak#1).
         self._eval_fn = jax.jit(self._eval_step)
-        self._predict_fn = jax.jit(self._predict_step)
+        # Replicated predictions: the output would otherwise inherit the
+        # input's DATA sharding, and fetching it on any one host would
+        # touch non-addressable shards under multi-host (r3 #7).
+        self._predict_fn = jax.jit(
+            self._predict_step, out_shardings=replicated_sharding(mesh)
+        )
 
     # -- compiled bodies -------------------------------------------------------
 
@@ -328,6 +333,14 @@ class SyncTrainer:
         chunk_fn, epoch_end_fn = self._build_stream_fns()
         data_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
         state_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        # Shard-0 extraction as a jitted collective with REPLICATED
+        # output: every host then holds (and may fetch) the full value.
+        # A plain device_get of the DATA-sharded block would touch
+        # non-addressable shards and fail on multi-host (r3 #7 coverage).
+        extract_fn = jax.jit(
+            lambda sb: jax.tree_util.tree_map(lambda a: a[0], sb),
+            out_shardings=replicated_sharding(mesh),
+        )
 
         # Stacked state: leading shard axis; per-shard dropout streams.
         base_rng = state.rng
@@ -391,8 +404,12 @@ class SyncTrainer:
                 k: float(sum(w * d[k] for (w, _), d in zip(chunk_metrics, fetched)) / total)
                 for k in fetched[0]
             }
+            snap = (
+                extract_fn(state_block)
+                if (validation_data is not None or callbacks)
+                else None
+            )
             if validation_data is not None:
-                snap = jax.tree_util.tree_map(lambda a: a[0], jax.device_get(state_block))
                 val = self.evaluate_state(
                     snap, *validation_data, batch_size=max(batch_size, 512)
                 )
@@ -400,15 +417,13 @@ class SyncTrainer:
             for key, value in metrics.items():
                 history.setdefault(key, []).append(value)
             if callbacks:
-                snap = jax.tree_util.tree_map(lambda a: a[0], jax.device_get(state_block))
                 for cb in callbacks:
                     cb(epoch, snap, metrics)
             if verbose:
                 desc = " ".join(f"{k}={v:.4f}" for k, v in metrics.items())
                 print(f"[sync/stream] epoch {epoch + 1}/{epochs} {desc}")
 
-        final = jax.tree_util.tree_map(lambda a: a[0], jax.device_get(state_block))
-        final = jax.device_put(final, replicated_sharding(mesh))
+        final = extract_fn(state_block)
         return final, history
 
     def _fit_parity(self, state, xs, ys, epochs, validation_data, verbose):

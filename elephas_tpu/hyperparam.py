@@ -397,7 +397,12 @@ class HyperParamModel:
         # reason). So even a host whose workers errored contributes what
         # it has (possibly nothing), completes the collective, and THEN
         # re-raises locally — peers finish with the surviving trials.
-        best = self._global_argmin(local_best, pid)
+        try:
+            best = self._global_argmin(local_best, pid)
+        except RuntimeError:
+            if errors:
+                raise errors[0]  # the objective's real failure, not the
+            raise                # derived "no trials job-wide"
         if errors:
             raise errors[0]
         self._last_best = best

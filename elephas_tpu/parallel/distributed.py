@@ -33,14 +33,28 @@ def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    heartbeat_timeout: Optional[int] = None,
 ) -> None:
     """Bring up ``jax.distributed`` if this looks like a multi-host job.
 
-    All three args default from the standard env vars
+    All three topology args default from the standard env vars
     (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
     ``JAX_PROCESS_ID``; TPU pods also auto-detect). Explicitly a no-op
     when nothing indicates multi-host, so single-host scripts need no
     guard.
+
+    ``heartbeat_timeout`` (seconds; ``$ELEPHAS_HEARTBEAT_TIMEOUT``,
+    default 30): how long a silent peer can miss coordination-service
+    heartbeats before EVERY surviving process is terminated with a fatal
+    "tasks are unhealthy" error. This is what bounds a peer dying inside
+    a sync-mode XLA collective — the collective itself would wait
+    indefinitely, but the error-polling thread aborts the process within
+    this budget (measured: rank 0 exits ~9.6s after a SIGKILL'd peer at
+    a 10s timeout — tests/test_multihost.py). Heartbeats ride a
+    background thread, so long compiles can't false-positive; JAX's own
+    default (100s) is tuned for clusters where restarts are expensive —
+    on a pod whose launcher restarts the whole job (SURVEY.md §5.3
+    delegation), 30s of dead-job detection beats 100s of hang.
     """
     coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
@@ -51,10 +65,13 @@ def initialize(
     if coordinator_address is None and num_processes in (None, 1):
         return  # single-host
 
+    if heartbeat_timeout is None:
+        heartbeat_timeout = int(os.environ.get("ELEPHAS_HEARTBEAT_TIMEOUT", "30"))
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        heartbeat_timeout_seconds=heartbeat_timeout,
     )
 
 

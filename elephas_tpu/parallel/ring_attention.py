@@ -6,8 +6,24 @@ longer than one chip's HBM are first-class: shard the sequence over the
 mesh's ``'seq'`` axis and rotate key/value shards around the ring with
 ``lax.ppermute`` (ICI neighbor traffic), accumulating each query shard's
 attention with a streaming (online) softmax. After ``seq_size`` steps,
-every query has attended to every key — exact attention, O(local_len²)
-memory, and the permute overlaps with the next chunk's compute.
+every query has attended to every key — exact attention, and the permute
+overlaps with the next chunk's compute.
+
+Two per-hop kernels, dispatched by shard length (``impl='auto'``):
+
+- **dense** (XLA): materializes the (local_q × local_k) score matrix per
+  hop — fastest below the Pallas crossover and the only path off-TPU.
+- **flash** (Pallas): each held K/V shard is folded with the MXU-tiled
+  flash kernel (``ops/attention_pallas.py``) returning (o, lse) partials
+  that are combined with O(local·d) online-softmax algebra, so VMEM
+  streams tiles and HBM never sees a score matrix. Contiguous shards
+  make the causal structure block-wise: the own-shard hop is local
+  causal, earlier-owner hops are full attention, later-owner hops are
+  skipped entirely (no FLOPs), halving the causal ring's work vs the
+  dense path's masked-but-computed hops. Backward is a custom VJP that
+  re-rotates K/V (plus their grad accumulators) around the ring and
+  reuses the fused Pallas dq/dk/dv kernels per hop with the global lse
+  residual.
 
 Usage: inside ``shard_map`` with q/k/v sharded as P(batch?, 'seq', ...)
 on the sequence dimension (see ``ring_self_attention`` and
@@ -16,10 +32,17 @@ on the sequence dimension (see ``ring_self_attention`` and
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from elephas_tpu.parallel.mesh import SEQ_AXIS
+
+# Same crossover the single-device dispatch measured (ops/attention.py):
+# below ~4k tokens per shard the Pallas launch/tiling overhead loses to
+# XLA; at/above it the flash hop wins (scripts/attention_bench.py --ring).
+_PALLAS_MIN_SHARD = 4096
 
 
 def require_seq_axis(axis_name: str = SEQ_AXIS):
@@ -41,13 +64,40 @@ def require_seq_axis(axis_name: str = SEQ_AXIS):
         ) from exc
 
 
-def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True):
+def ring_attention(
+    q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True, impl: str = "auto"
+):
     """Attention across a sequence-sharded ring.
 
     q, k, v: local shards of shape (batch, heads, local_len, head_dim);
     the global sequence is the concatenation of shards in axis order.
     Returns the local output shard (batch, heads, local_len, head_dim).
+
+    ``impl``: 'auto' (flash on TPU at >= _PALLAS_MIN_SHARD tokens/shard,
+    dense otherwise), 'dense', or 'flash' (XLA pair kernels off-TPU, for
+    structure tests). Differentiable on every path.
     """
+    if impl not in ("auto", "dense", "flash"):
+        raise ValueError(f"impl must be auto|dense|flash, got {impl!r}")
+    if impl == "auto":
+        use_flash = (
+            jax.default_backend() == "tpu" and q.shape[2] >= _PALLAS_MIN_SHARD
+        )
+    else:
+        use_flash = impl == "flash"
+    if not use_flash:
+        return _ring_dense(q, k, v, axis_name, causal)
+    return _ring_flash(
+        q, k, v, axis_name, causal, jax.default_backend() == "tpu"
+    )
+
+
+# ------------------------------------------------------------------ dense
+
+
+def _ring_dense(q, k, v, axis_name: str, causal: bool):
+    """Per-hop dense scores with a streaming softmax (the sub-crossover
+    and non-TPU path)."""
     my_idx = require_seq_axis(axis_name)
     n = jax.lax.axis_size(axis_name)
     b, h, local_len, d = q.shape
@@ -99,7 +149,182 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True):
     return out.astype(q.dtype)
 
 
-def ring_self_attention(mesh, q, k, v, causal: bool = True):
+# ------------------------------------------------------------------ flash
+
+_TINY = 1e-30
+
+
+def _pair_attn(q, k, v, causal: bool, use_pallas: bool):
+    """One ring hop: full (or locally-causal) attention of the local q
+    shard against one K/V shard, returning (o, lse) for online-softmax
+    combination. Pallas flash kernel on TPU; an XLA reference with
+    identical (o, lse) semantics elsewhere (CPU structure tests)."""
+    if use_pallas:
+        from elephas_tpu.ops.attention_pallas import pallas_flash_attention
+
+        return pallas_flash_attention(q, k, v, causal=causal, return_lse=True)
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32)
+    )
+    if causal:
+        lq, lk = scores.shape[-2:]
+        mask = jnp.arange(lk)[None, :] <= jnp.arange(lq)[:, None]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    m = scores.max(axis=-1)
+    shift = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(scores - shift[..., None])
+    l = p.sum(axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)) / jnp.maximum(
+        l[..., None], _TINY
+    )
+    lse = shift + jnp.log(jnp.maximum(l, _TINY))
+    return o.astype(q.dtype), lse
+
+
+def _pair_attn_bwd(q, k, v, o, lse, do, causal: bool, use_pallas: bool):
+    """dq/dk/dv contribution of one ring hop, recomputed from the GLOBAL
+    (o, lse) residuals — p_ij = exp(s_ij - lse_i) is this hop's slice of
+    the global softmax, so per-hop grads sum to the exact ring grads."""
+    if use_pallas:
+        from elephas_tpu.ops.attention_pallas import pallas_flash_attention_bwd
+
+        return pallas_flash_attention_bwd(q, k, v, o, lse, do, causal=causal)
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qf * scale, kf)
+    if causal:
+        lq, lk = scores.shape[-2:]
+        mask = jnp.arange(lk)[None, :] <= jnp.arange(lq)[:, None]
+        p = jnp.where(mask[None, None], jnp.exp(scores - lse[..., None]), 0.0)
+    else:
+        p = jnp.exp(scores - lse[..., None])
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _combine(o1, lse1, o2, lse2):
+    """Merge two (o, lse) partial-softmax results (f32 o's)."""
+    m = jnp.maximum(lse1, lse2)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = jnp.maximum(w1 + w2, _TINY)
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / denom[..., None]
+    return o, m + jnp.log(denom)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, use_pallas):
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, use_pallas)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, use_pallas):
+    my_idx = require_seq_axis(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Hop 0 is always the own shard: local causal (or full). Contiguous
+    # sharding makes every later hop either FULL (owner earlier in the
+    # sequence) or EMPTY (owner later — skipped, no kernel work), so the
+    # kernels never need global position masks.
+    o, lse = _pair_attn(q, k, v, causal=causal, use_pallas=use_pallas)
+    of = o.astype(jnp.float32)
+    k_cur = jax.lax.ppermute(k, axis_name, perm)
+    v_cur = jax.lax.ppermute(v, axis_name, perm)
+
+    def step(carry, s):
+        of, lse, k_cur, v_cur = carry
+        owner = (my_idx - s) % n
+
+        def fold(args):
+            of, lse = args
+            o2, lse2 = _pair_attn(
+                q, k_cur, v_cur, causal=False, use_pallas=use_pallas
+            )
+            return _combine(of, lse, o2.astype(jnp.float32), lse2)
+
+        if causal:
+            of, lse = jax.lax.cond(owner < my_idx, fold, lambda a: a, (of, lse))
+        else:
+            of, lse = fold((of, lse))
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (of, lse, k_next, v_next), None
+
+    (of, lse, _, _), _ = jax.lax.scan(
+        step, (of, lse, k_cur, v_cur), jnp.arange(1, n)
+    )
+    out = of.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, use_pallas, residuals, g):
+    q, k, v, out, lse = residuals
+    my_idx = require_seq_axis(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Same rotation schedule as forward; each K/V shard travels WITH its
+    # grad accumulator, collecting every device's contribution, and is
+    # home after n rotations.
+    dq, dk0, dv0 = _pair_attn_bwd(
+        q, k, v, out, lse, g, causal=causal, use_pallas=use_pallas
+    )
+    dq = dq.astype(jnp.float32)
+    k_cur = jax.lax.ppermute(k, axis_name, perm)
+    v_cur = jax.lax.ppermute(v, axis_name, perm)
+    dk_cur = jax.lax.ppermute(dk0.astype(jnp.float32), axis_name, perm)
+    dv_cur = jax.lax.ppermute(dv0.astype(jnp.float32), axis_name, perm)
+
+    def step(carry, s):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        owner = (my_idx - s) % n
+
+        def fold(args):
+            dq, dk_cur, dv_cur = args
+            dqc, dkc, dvc = _pair_attn_bwd(
+                q, k_cur, v_cur, out, lse, g, causal=False, use_pallas=use_pallas
+            )
+            return (
+                dq + dqc.astype(jnp.float32),
+                dk_cur + dkc.astype(jnp.float32),
+                dv_cur + dvc.astype(jnp.float32),
+            )
+
+        if causal:
+            dq, dk_cur, dv_cur = jax.lax.cond(
+                owner < my_idx, fold, lambda a: a, (dq, dk_cur, dv_cur)
+            )
+        else:
+            dq, dk_cur, dv_cur = fold((dq, dk_cur, dv_cur))
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_next = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_next = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return (dq, k_next, v_next, dk_next, dv_next), None
+
+    (dq, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
+        step, (dq, k_cur, v_cur, dk_cur, dv_cur), jnp.arange(1, n)
+    )
+    return dq.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_self_attention(mesh, q, k, v, causal: bool = True, impl: str = "auto"):
     """Convenience wrapper: shard_map ring attention over ``mesh``'s seq
     axis. q/k/v are global (batch, heads, seq, head_dim) arrays; sequence
     must divide evenly by the seq-axis size."""
@@ -108,7 +333,8 @@ def ring_self_attention(mesh, q, k, v, causal: bool = True):
     spec = P(None, None, SEQ_AXIS, None)
 
     def body(q_, k_, v_):
-        return ring_attention(q_, k_, v_, axis_name=SEQ_AXIS, causal=causal)
+        return ring_attention(q_, k_, v_, axis_name=SEQ_AXIS, causal=causal,
+                              impl=impl)
 
     return jax.jit(
         jax.shard_map(

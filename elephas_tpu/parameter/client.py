@@ -23,6 +23,7 @@ connection would stall ``timeout``-per-attempt against a wedged server.
 
 from __future__ import annotations
 
+import hmac
 import http.client
 import os
 import pickle
@@ -132,11 +133,13 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
     module docstring's idempotency/wedge contract.
     """
 
-    def __init__(self, master_url: str, timeout: float = 60.0):
+    def __init__(self, master_url: str, timeout: float = 60.0,
+                 auth_key: Optional[bytes] = None):
         host, port = master_url.rsplit(":", 1)
         self.master_url = master_url
         self._addr = (host, int(port))
         self.timeout = timeout
+        self.auth_key = auth_key  # HMAC secret; see HttpServer auth docs
 
     def _connect_once(self, transfer_timeout: Optional[float] = None) -> http.client.HTTPConnection:
         conn = http.client.HTTPConnection(*self._addr, timeout=_CONNECT_TIMEOUT)
@@ -146,10 +149,23 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
         )
         return conn
 
-    @staticmethod
-    def _roundtrip(conn, method: str, path: str, payload) -> bytes:
+    def _roundtrip(self, conn, method: str, path: str, payload) -> bytes:
         try:
             headers = {"Content-Type": "application/octet-stream"} if payload else {}
+            nonce = b""
+            if self.auth_key is not None:
+                import os as _os
+                import time as _time
+
+                nonce = _os.urandom(16)
+                ts = repr(_time.time())
+                headers["X-Elephas-Nonce"] = nonce.hex()
+                headers["X-Elephas-TS"] = ts
+                headers["X-Elephas-Auth"] = socket_utils.frame_mac(
+                    self.auth_key,
+                    method.encode() + path.encode() + nonce + ts.encode()
+                    + (payload or b""),
+                ).hex()
             conn.request(method, path, body=payload, headers=headers)
             resp = conn.getresponse()
             body = resp.read()
@@ -157,6 +173,18 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
                 raise RuntimeError(
                     f"parameter server returned HTTP {resp.status} for {path}"
                 )
+            if self.auth_key is not None and path != "/health":
+                # Verify the server's signature — bound to OUR nonce, so
+                # a captured response can't be replayed into a different
+                # exchange — BEFORE any unpickle of the body.
+                want = socket_utils.frame_mac(self.auth_key, nonce + body).hex()
+                if not hmac.compare_digest(
+                    resp.headers.get("X-Elephas-Auth", ""), want
+                ):
+                    raise RuntimeError(
+                        f"parameter server response for {path} failed HMAC "
+                        "verification (wrong or missing auth key)"
+                    )
             return body
         finally:
             conn.close()
@@ -216,28 +244,33 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
         return int(self._get(f"/barrier/{tag}", "barrier_count"))
 
 
-def make_client(mode: str, address: str) -> BaseParameterClient:
+def make_client(
+    mode: str, address: str, auth_key: Optional[bytes] = None
+) -> BaseParameterClient:
     """Client for a parameter server reachable at ``address`` ("ip:port").
 
     The cross-host worker path: hosts that did not start the server dial
     the address host 0 broadcast (reference topology — every worker talks
-    to the one driver PS, SURVEY.md §3.2).
+    to the one driver PS, SURVEY.md §3.2). ``auth_key``: the DCN-broadcast
+    HMAC secret for authenticated multi-host wire traffic.
     """
     if mode == "http":
-        return HttpClient(address)
+        return HttpClient(address, auth_key=auth_key)
     if mode == "socket":
-        return SocketClient(address)
+        return SocketClient(address, auth_key=auth_key)
     raise ValueError(f"no wire client for parameter_server_mode={mode!r}")
 
 
 class SocketClient(_WireBarrierMixin, BaseParameterClient):
     """Persistent framed-TCP connection (one per worker thread)."""
 
-    def __init__(self, master_url: str, timeout: float = 60.0):
+    def __init__(self, master_url: str, timeout: float = 60.0,
+                 auth_key: Optional[bytes] = None):
         host, port = master_url.rsplit(":", 1)
         self.master_url = master_url
         self._addr = (host, int(port))
         self.timeout = timeout
+        self.auth_key = auth_key  # HMAC frame secret (utils.sockets)
         self._sock = None
         self._lock = threading.Lock()  # one in-flight request per connection
 
@@ -262,8 +295,8 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
         for retry in (idempotent, False):
             sock = self._connection()
             try:
-                socket_utils.send(sock, frame)
-                return socket_utils.receive(sock)
+                socket_utils.send(sock, frame, key=self.auth_key)
+                return socket_utils.receive(sock, key=self.auth_key)
             except (socket.timeout, TimeoutError) as exc:
                 # Read timeout on an ESTABLISHED connection: the server is
                 # wedged, not restarting — another ``timeout``-long attempt
@@ -314,8 +347,8 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
             sock = socket.create_connection(self._addr, timeout=_CONNECT_TIMEOUT)
             try:
                 sock.settimeout(_CONNECT_TIMEOUT)
-                socket_utils.send(sock, ("c", "health"))
-                socket_utils.receive(sock)
+                socket_utils.send(sock, ("c", "health"), key=self.auth_key)
+                socket_utils.receive(sock, key=self.auth_key)
             finally:
                 sock.close()
             return True

@@ -13,6 +13,7 @@ protocol, no dependency.
 
 from __future__ import annotations
 
+import hmac
 import os
 import pickle
 import socketserver
@@ -110,11 +111,24 @@ class HttpServer(BaseParameterServer):
         device: Optional[jax.Device] = None,
         host: Optional[str] = None,
         granularity: str = "tree",
+        auth_key: Optional[bytes] = None,
     ):
+        """``auth_key``: shared HMAC-SHA256 secret. When set, every
+        request must carry ``X-Elephas-Auth`` = hexmac(method + path +
+        nonce + ts + body) plus fresh ``X-Elephas-Nonce``/``X-Elephas-TS``
+        headers (verified BEFORE the body is unpickled — a bad tag is a
+        403, a replayed/stale nonce likewise, and nothing is applied);
+        every response body is signed bound to the REQUEST's nonce so a
+        captured response can't be replayed to a later request either.
+        ``/health`` stays open (liveness probe, no pickles). Multi-host
+        fits enable this by default with a DCN-broadcast secret (async
+        engine)."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
         self.port = port
+        self.auth_key = auth_key
+        self.replay_guard = socket_utils.ReplayGuard() if auth_key else None
         self.barriers = _BarrierBook()
         self._httpd = None
         self._thread = None
@@ -122,14 +136,52 @@ class HttpServer(BaseParameterServer):
     def start(self) -> None:
         buffer = self.buffer
         barriers = self.barriers
+        auth_key = self.auth_key
+        replay_guard = self.replay_guard
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # silence per-request stderr spam
                 pass
 
-            def _send_count(self, count: int) -> None:
-                body = str(count).encode()
+            def _authed(self, body: bytes = b"") -> bool:
+                if auth_key is None:
+                    return True
+                claim = self.headers.get("X-Elephas-Auth", "")
+                nonce_hex = self.headers.get("X-Elephas-Nonce", "")
+                ts_str = self.headers.get("X-Elephas-TS", "")
+                try:
+                    nonce = bytes.fromhex(nonce_hex)
+                    ts = float(ts_str)
+                except ValueError:
+                    nonce, ts = b"", 0.0
+                want = socket_utils.frame_mac(
+                    auth_key,
+                    self.command.encode() + self.path.encode()
+                    + nonce + ts_str.encode() + body,
+                ).hex()
+                if nonce and hmac.compare_digest(claim, want):
+                    try:
+                        replay_guard.check(nonce, ts)
+                        self._req_nonce = nonce
+                        return True
+                    except ConnectionError:
+                        pass
+                self.send_error(403, "authentication failed")
+                return False
+
+            def _reply(self, body: bytes, content_type: Optional[str] = None) -> None:
                 self.send_response(200)
+                if content_type:
+                    self.send_header("Content-Type", content_type)
+                if auth_key is not None:
+                    # Bound to the request nonce: stale responses can't
+                    # be replayed into a different exchange.
+                    self.send_header(
+                        "X-Elephas-Auth",
+                        socket_utils.frame_mac(
+                            auth_key, getattr(self, "_req_nonce", b"") + body
+                        ).hex(),
+                    )
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -137,36 +189,33 @@ class HttpServer(BaseParameterServer):
             def do_GET(self):  # noqa: N802
                 path = self.path.rstrip("/")
                 if path == "/health":
-                    body = b"ok"
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                elif path == "/parameters":
-                    payload = pickle.dumps(
-                        buffer.get_numpy(), protocol=pickle.HIGHEST_PROTOCOL
+                    self._reply(b"ok")  # open: liveness probe, no pickles
+                    return
+                if not self._authed():
+                    return
+                if path == "/parameters":
+                    self._reply(
+                        pickle.dumps(
+                            buffer.get_numpy(), protocol=pickle.HIGHEST_PROTOCOL
+                        ),
+                        content_type="application/octet-stream",
                     )
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/octet-stream")
-                    self.send_header("Content-Length", str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
                 elif path.startswith("/barrier/"):
-                    self._send_count(barriers.count(path[len("/barrier/"):]))
+                    self._reply(str(barriers.count(path[len("/barrier/"):])).encode())
                 else:
                     self.send_error(404)
 
             def do_POST(self):  # noqa: N802
                 path = self.path.rstrip("/")
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if not self._authed(body):
+                    return
                 if path == "/update":
-                    length = int(self.headers.get("Content-Length", 0))
-                    delta = pickle.loads(self.rfile.read(length))
-                    buffer.apply_delta(delta)
-                    self.send_response(200)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
+                    buffer.apply_delta(pickle.loads(body))
+                    self._reply(b"")
                 elif path.startswith("/barrier/"):
-                    self._send_count(barriers.arrive(path[len("/barrier/"):]))
+                    self._reply(str(barriers.arrive(path[len("/barrier/"):])).encode())
                 else:
                     self.send_error(404)
 
@@ -192,25 +241,35 @@ class HttpServer(BaseParameterServer):
     def client(self):
         from elephas_tpu.parameter.client import HttpClient
 
-        return HttpClient(f"{_dial_host(self.host)}:{self.port}")
+        return HttpClient(
+            f"{_dial_host(self.host)}:{self.port}", auth_key=self.auth_key
+        )
 
 
 class _SocketHandler(socketserver.BaseRequestHandler):
     def handle(self):
         buffer = self.server.buffer  # type: ignore[attr-defined]
         barriers = self.server.barriers  # type: ignore[attr-defined]
+        key = self.server.auth_key  # type: ignore[attr-defined]
+        guard = self.server.replay_guard  # type: ignore[attr-defined]
         try:
             while True:
-                kind, payload = socket_utils.receive(self.request)
+                # With auth_key set, receive() verifies the frame's HMAC
+                # and replay-freshness BEFORE unpickling; a bad tag or a
+                # replayed nonce raises ConnectionError and the
+                # connection closes without touching the buffer.
+                kind, payload = socket_utils.receive(
+                    self.request, key=key, replay_guard=guard
+                )
                 if kind == "g":
-                    socket_utils.send(self.request, buffer.get_numpy())
+                    socket_utils.send(self.request, buffer.get_numpy(), key=key)
                 elif kind == "u":
                     buffer.apply_delta(payload)
-                    socket_utils.send(self.request, b"ok")
+                    socket_utils.send(self.request, b"ok", key=key)
                 elif kind == "b":  # barrier arrive(tag) -> count
-                    socket_utils.send(self.request, barriers.arrive(payload))
+                    socket_utils.send(self.request, barriers.arrive(payload), key=key)
                 elif kind == "c":  # barrier count(tag)
-                    socket_utils.send(self.request, barriers.count(payload))
+                    socket_utils.send(self.request, barriers.count(payload), key=key)
                 else:
                     break
         except (ConnectionError, OSError):
@@ -234,11 +293,18 @@ class SocketServer(BaseParameterServer):
         device: Optional[jax.Device] = None,
         host: Optional[str] = None,
         granularity: str = "tree",
+        auth_key: Optional[bytes] = None,
     ):
+        """``auth_key``: shared HMAC-SHA256 secret — every frame in both
+        directions carries a tag (nonce+timestamp under the MAC) verified
+        before unpickling, and the server rejects replayed/stale nonces
+        (see ``utils.sockets.send/receive``/``ReplayGuard``)."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
         self.port = port
+        self.auth_key = auth_key
+        self.replay_guard = socket_utils.ReplayGuard() if auth_key else None
         self.barriers = _BarrierBook()
         self._server = None
         self._thread = None
@@ -247,6 +313,8 @@ class SocketServer(BaseParameterServer):
         self._server = _ThreadingTCPServer((self.host, self.port), _SocketHandler)
         self._server.buffer = self.buffer  # type: ignore[attr-defined]
         self._server.barriers = self.barriers  # type: ignore[attr-defined]
+        self._server.auth_key = self.auth_key  # type: ignore[attr-defined]
+        self._server.replay_guard = self.replay_guard  # type: ignore[attr-defined]
         if self.port == 0:
             self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
@@ -264,7 +332,9 @@ class SocketServer(BaseParameterServer):
     def client(self):
         from elephas_tpu.parameter.client import SocketClient
 
-        return SocketClient(f"{_dial_host(self.host)}:{self.port}")
+        return SocketClient(
+            f"{_dial_host(self.host)}:{self.port}", auth_key=self.auth_key
+        )
 
 
 def make_server(
@@ -275,16 +345,18 @@ def make_server(
     device: Optional[jax.Device] = None,
     host: Optional[str] = None,
     granularity: str = "tree",
+    auth_key: Optional[bytes] = None,
 ) -> BaseParameterServer:
     """Factory keyed on the reference's ``parameter_server_mode``.
     ``granularity`` ('tree'|'leaf') sets the hogwild apply isolation —
-    see ``ParameterBuffer``'s memory-model note."""
+    see ``ParameterBuffer``'s memory-model note. ``auth_key`` turns on
+    HMAC wire authentication for the http/socket transports."""
     if mode == "local":
         return LocalServer(params, lock=lock, device=device, granularity=granularity)
     if mode == "http":
         return HttpServer(params, lock=lock, port=port, device=device, host=host,
-                          granularity=granularity)
+                          granularity=granularity, auth_key=auth_key)
     if mode == "socket":
         return SocketServer(params, lock=lock, port=port, device=device, host=host,
-                            granularity=granularity)
+                            granularity=granularity, auth_key=auth_key)
     raise ValueError(f"parameter_server_mode must be local|http|socket, got {mode!r}")

@@ -8,18 +8,66 @@ length prefix over raw TCP and discovers the driver endpoint via
 Here the same framing carries *control-plane* traffic only (async-mode
 deltas between hosts, trial dispatch). Tensor data between chips rides ICI
 via XLA collectives (SURVEY.md §2.3) and never touches these sockets on
-the single-host path. Frames are ``!Q``-length-prefixed pickles; pickle is
-acceptable because every endpoint is part of the same trusted job (same
-trust model as the reference and as Spark's closure shipping).
+the single-host path. Frames are ``!Q``-length-prefixed pickles; because
+``pickle.loads`` on attacker bytes is code execution, frames can carry an
+HMAC-SHA256 tag (``key=``): the receiver verifies the tag BEFORE
+unpickling and treats a mismatch as a connection error. Multi-host runs
+turn this on by default with a secret broadcast over the DCN control
+plane (async engine); keyless framing matches the reference's
+same-trusted-job model and stays the single-host loopback default.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
 import pickle
 import socket
 import struct
+import threading
+import time
 
 _LEN = struct.Struct("!Q")
+_MAC_LEN = 32  # HMAC-SHA256 digest size
+_NONCE_LEN = 16
+_TS = struct.Struct("!d")
+_AUTH_HDR_LEN = _NONCE_LEN + _TS.size
+
+
+def frame_mac(key: bytes, payload: bytes) -> bytes:
+    """HMAC-SHA256 tag for one wire payload."""
+    return hmac.new(key, payload, hashlib.sha256).digest()
+
+
+class ReplayGuard:
+    """Reject duplicate or stale authenticated frames (server side).
+
+    An HMAC alone authenticates the SENDER, not the OCCASION: a captured
+    update/barrier frame replays verbatim and would double-apply. Each
+    authenticated frame therefore carries a random nonce + wall-clock
+    timestamp under the MAC; the receiver rejects frames outside the
+    freshness ``window`` (hosts in one job are NTP-close; 300s is
+    generous) and nonces it has already seen within it. Nonce memory is
+    bounded by pruning expired entries."""
+
+    def __init__(self, window: float = 300.0):
+        self.window = window
+        self._seen: dict = {}  # nonce -> expiry
+        self._lock = threading.Lock()
+
+    def check(self, nonce: bytes, ts: float) -> None:
+        now = time.time()
+        if abs(now - ts) > self.window:
+            raise ConnectionError(
+                "authenticated frame outside the replay-freshness window"
+            )
+        with self._lock:
+            if len(self._seen) > 4096:
+                self._seen = {n: e for n, e in self._seen.items() if e > now}
+            if nonce in self._seen:
+                raise ConnectionError("replayed authenticated frame rejected")
+            self._seen[nonce] = now + self.window
 
 
 def host_ip() -> str:
@@ -58,10 +106,19 @@ def determine_master(port: int = 4000) -> str:
     return f"{host_ip()}:{port}"
 
 
-def send(sock: socket.socket, obj) -> None:
-    """Pickle ``obj`` and send it with an 8-byte length prefix."""
+def send(sock: socket.socket, obj, key: bytes | None = None) -> None:
+    """Pickle ``obj`` and send it with an 8-byte length prefix; with
+    ``key``, the frame is [mac32][nonce16][ts8][payload] with the
+    HMAC-SHA256 tag covering nonce+ts+payload (see ``ReplayGuard``)."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    if key is not None:
+        header = os.urandom(_NONCE_LEN) + _TS.pack(time.time())
+        body = header + payload
+        sock.sendall(
+            _LEN.pack(len(body) + _MAC_LEN) + frame_mac(key, body) + body
+        )
+    else:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -75,7 +132,30 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def receive(sock: socket.socket):
-    """Receive one length-prefixed pickled object (inverse of ``send``)."""
+def receive(
+    sock: socket.socket,
+    key: bytes | None = None,
+    replay_guard: ReplayGuard | None = None,
+):
+    """Receive one length-prefixed pickled object (inverse of ``send``).
+
+    With ``key``, the frame's HMAC tag is verified BEFORE unpickling —
+    unauthenticated or tampered bytes never reach ``pickle.loads``.
+    ``replay_guard`` (servers) additionally rejects duplicate/stale
+    nonces under the MAC."""
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, length))
+    data = _recv_exact(sock, length)
+    if key is not None:
+        if length < _MAC_LEN + _AUTH_HDR_LEN:
+            raise ConnectionError("authenticated frame shorter than its header")
+        tag, body = data[:_MAC_LEN], data[_MAC_LEN:]
+        if not hmac.compare_digest(tag, frame_mac(key, body)):
+            raise ConnectionError(
+                "wire-frame authentication failed (bad or missing HMAC)"
+            )
+        nonce = body[:_NONCE_LEN]
+        (ts,) = _TS.unpack(body[_NONCE_LEN:_AUTH_HDR_LEN])
+        if replay_guard is not None:
+            replay_guard.check(nonce, ts)
+        return pickle.loads(body[_AUTH_HDR_LEN:])
+    return pickle.loads(data)

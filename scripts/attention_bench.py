@@ -55,6 +55,41 @@ def build(seq: int, impl: str, heads: int = 8, dim: int = 64, batch: int = 1):
     return grad_fn, (q, k, v)
 
 
+def build_ring(tokens_per_shard: int, impl: str, heads: int = 8, dim: int = 64,
+               batch: int = 1):
+    """Ring arm (VERDICT r3 #4): dense-hop vs flash-hop ring attention at
+    a given tokens/shard, fwd+bwd through the shipped custom-VJP path.
+    On this 1-chip env the seq axis is size 1 — the ring degenerates to
+    its per-hop kernel, which is exactly what the dense-vs-flash hop
+    comparison measures (rotation is ICI traffic either way)."""
+    from jax.sharding import PartitionSpec as P
+
+    from elephas_tpu.parallel.mesh import SEQ_AXIS, build_mesh
+    from elephas_tpu.parallel.ring_attention import ring_attention
+
+    n_seq = 1  # all local devices on the seq axis would also work; bench 1
+    mesh = build_mesh(num_data=1, num_seq=n_seq)
+    spec = P(None, None, SEQ_AXIS, None)
+
+    def body(q_, k_, v_):
+        out = ring_attention(q_, k_, v_, axis_name=SEQ_AXIS, causal=True,
+                             impl=impl)
+        return jax.lax.psum(jnp.sum(out.astype(jnp.float32) ** 2), SEQ_AXIS)
+
+    loss_fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(),
+        check_vma=False,
+    )
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1, 2)))
+    rng = np.random.default_rng(0)
+    shape = (batch, heads, tokens_per_shard * n_seq, dim)
+    q, k, v = (
+        jax.device_put(rng.normal(size=shape).astype(np.float32).astype(jnp.bfloat16))
+        for _ in range(3)
+    )
+    return grad_fn, (q, k, v)
+
+
 def measure(fn, args, steps: int, warmup: int = 3) -> float:
     for _ in range(warmup):
         loss, grads = fn(*args)

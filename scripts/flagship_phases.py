@@ -1,0 +1,125 @@
+"""Per-epoch phase breakdown of the flagship hogwild CIFAR config (VERDICT r3 #1).
+
+Two passes over the exact workload `parity.py`'s cifar10_resnet18_hogwild
+runs (synthetic CIFAR, ResNet-18 w64 bf16, batch 512, 10k-row validation):
+
+1. ``--phases``: AsyncTrainer.profile_phases forces device results at
+   phase boundaries (reshuffle / pull / train / push / fire_snapshot /
+   fire_val / fire_callbacks) and prints mean seconds per phase per epoch,
+   warmup epoch excluded. Forcing serializes the dispatch pipeline, so
+   the per-phase numbers are costs, not a throughput measurement.
+2. throughput: a plain fit with an epoch-timestamp callback — the same
+   steady-state samples/sec `parity.py` reports.
+
+Usage:  python scripts/flagship_phases.py [--epochs 6] [--quickish]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def build(quickish: bool):
+    from elephas_tpu import compile_model
+    from elephas_tpu.data.datasets import load_cifar10, one_hot
+    from elephas_tpu.data.rdd import ShardedDataset
+    from elephas_tpu.models import get_model
+
+    (xtr, ytr), (xte, yte), real = load_cifar10()
+    if quickish:
+        xtr, ytr = xtr[:8192], ytr[:8192]
+        xte, yte = xte[:2048], yte[:2048]
+    mean = np.array([0.4914, 0.4822, 0.4465], np.float32) * 255.0
+    std = np.array([0.247, 0.243, 0.261], np.float32) * 255.0
+    x = (xtr.astype(np.float32) - mean) / std
+    y = one_hot(ytr, 10)
+    xv = (xte.astype(np.float32) - mean) / std
+    yv = one_hot(yte, 10)
+    dtype = "bfloat16" if jax.default_backend() == "tpu" else "float32"
+    net = compile_model(
+        get_model("resnet18", num_classes=10, width=64, dtype=dtype),
+        optimizer={"name": "momentum", "learning_rate": 0.05},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=x.shape[1:],
+    )
+    return net, ShardedDataset(x, y, 1), (xv, yv), len(x)
+
+
+def make_trainer(net):
+    from elephas_tpu.engine.async_engine import AsyncTrainer
+    from elephas_tpu.parallel.mesh import build_mesh
+
+    return AsyncTrainer(net, build_mesh(num_data=1), frequency="epoch", lock=False)
+
+
+def run_phases(epochs: int, quickish: bool) -> dict:
+    net, dataset, val, n_rows = build(quickish)
+    trainer = make_trainer(net)
+    trainer.profile_phases = True
+    timer_times = []
+    trainer.fit(
+        dataset, epochs=epochs, batch_size=512, validation_data=val,
+        callbacks=[lambda e, s, m: timer_times.append(time.perf_counter())],
+    )
+    # Warmup epoch (jit compile) excluded from every phase mean.
+    table = {
+        phase: round(float(np.mean(ts[1:])), 4) if len(ts) > 1 else None
+        for phase, ts in sorted(trainer.phase_times.items())
+    }
+    worker = sum(v or 0 for k, v in table.items() if not k.startswith("fire_"))
+    fire = sum(v or 0 for k, v in table.items() if k.startswith("fire_"))
+    return {
+        "phase_means_sec": table,
+        "worker_critical_path_sec": round(worker, 4),
+        "fire_offloaded_sec": round(fire, 4),
+        "train_rows": n_rows,
+    }
+
+
+def run_throughput(epochs: int, quickish: bool) -> dict:
+    net, dataset, val, n_rows = build(quickish)
+    trainer = make_trainer(net)
+    trainer.fit(
+        dataset, epochs=epochs, batch_size=512, validation_data=val,
+        callbacks=[lambda e, s, m: None],
+    )
+    # Worker-barrier timestamps: the true training cadence (fire-callback
+    # times lag by the in-flight overlapped fire).
+    times = trainer.epoch_end_times
+    span = times[-1] - times[0]
+    return {
+        "samples_per_sec_steady": round(n_rows * (len(times) - 1) / span, 1),
+        "epochs_timed": len(times) - 1,
+        "train_rows": n_rows,
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=6)
+    parser.add_argument("--quickish", action="store_true",
+                        help="8k-row slice (fast sanity, not the headline)")
+    parser.add_argument("--phases-only", action="store_true")
+    parser.add_argument("--throughput-only", action="store_true")
+    args = parser.parse_args()
+
+    out = {}
+    if not args.throughput_only:
+        out["phases"] = run_phases(args.epochs, args.quickish)
+    if not args.phases_only:
+        out["throughput"] = run_throughput(args.epochs, args.quickish)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -79,3 +79,49 @@ def test_ring_attention_eight_way(devices):
     out = ring_self_attention(mesh, q, k, v, causal=True)
     ref = dense_causal_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_ring_matches_dense_ring(devices, causal):
+    """The flash ring (per-hop (o, lse) partials + online-softmax combine,
+    Pallas kernels on TPU / XLA pair kernels here) must be numerically
+    the dense ring: same hops, different per-hop kernel (VERDICT r3 #4)."""
+    mesh = build_mesh(num_data=1, num_seq=4)
+    q, k, v = _qkv(batch=2, heads=2, seq=64, dim=16, seed=5)
+    out_flash = ring_self_attention(mesh, q, k, v, causal=causal, impl="flash")
+    out_dense = ring_self_attention(mesh, q, k, v, causal=causal, impl="dense")
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_dense), atol=1e-4
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_ring_grad_matches_dense_ring(devices, causal):
+    """The flash ring's custom VJP (rotating K/V + grad accumulators,
+    per-hop dq/dk/dv from the global lse) must match autodiff through
+    the dense ring."""
+    from jax.sharding import PartitionSpec as P
+
+    from elephas_tpu.parallel.mesh import SEQ_AXIS
+    from elephas_tpu.parallel.ring_attention import ring_attention
+
+    mesh = build_mesh(num_data=1, num_seq=4)
+    q, k, v = _qkv(batch=1, heads=2, seq=64, dim=8, seed=6)
+    spec = P(None, None, SEQ_AXIS, None)
+
+    def make_loss(impl):
+        def body(q_, k_, v_):
+            out = ring_attention(q_, k_, v_, axis_name=SEQ_AXIS, causal=causal,
+                                 impl=impl)
+            return jax.lax.psum(jnp.sum(out.astype(jnp.float32) ** 2), SEQ_AXIS)
+
+        sharded = jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P(),
+            check_vma=False,
+        )
+        return lambda q_, k_, v_: sharded(q_, k_, v_)
+
+    g_flash = jax.jit(jax.grad(make_loss("flash"), argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.jit(jax.grad(make_loss("dense"), argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)

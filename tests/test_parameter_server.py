@@ -67,6 +67,151 @@ def test_transport_get_update_roundtrip(server_cls):
         server.stop()
 
 
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_authenticated_transport_roundtrip(server_cls):
+    """With a shared HMAC key, get/update/barriers/health all work and
+    the wire protocol is unchanged for the legitimate job (VERDICT r3
+    #8: multi-host fits broadcast such a key over DCN by default)."""
+    key = b"k" * 32
+    server = server_cls(_params(), lock=True, port=0, auth_key=key)
+    server.start()
+    try:
+        client = server.client()
+        assert client.auth_key == key
+        pulled = client.get_parameters()
+        np.testing.assert_allclose(pulled["dense"]["w"], 1.0)
+        delta = {
+            "dense": {"w": np.full((4, 4), 0.5, np.float32), "b": np.ones(4, np.float32)}
+        }
+        client.update_parameters(delta)
+        np.testing.assert_allclose(client.get_parameters()["dense"]["w"], 0.5)
+        assert client.barrier_arrive("t") == 1
+        assert client.barrier_count("t") == 1
+        assert client.health() is True
+        if hasattr(client, "close"):
+            client.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_unauthenticated_writes_rejected(server_cls):
+    """A client WITHOUT the key (an attacker on the pod network) must not
+    get a pickle into the server: updates and reads are refused before
+    any ``pickle.loads`` and the buffer never changes."""
+    from elephas_tpu.parameter.client import (
+        HttpClient, ParameterServerUnavailable, SocketClient,
+    )
+
+    key = b"s" * 32
+    server = server_cls(_params(), lock=True, port=0, auth_key=key)
+    server.start()
+    try:
+        cls = HttpClient if server_cls is HttpServer else SocketClient
+        for bad_key in (None, b"wrong" * 8):
+            intruder = cls(f"127.0.0.1:{server.port}", auth_key=bad_key)
+            delta = {
+                "dense": {"w": np.ones((4, 4), np.float32), "b": np.ones(4, np.float32)}
+            }
+            with pytest.raises((RuntimeError, ParameterServerUnavailable, ConnectionError)):
+                intruder.update_parameters(delta)
+            with pytest.raises((RuntimeError, ParameterServerUnavailable, ConnectionError)):
+                intruder.get_parameters()
+            if hasattr(intruder, "close"):
+                intruder.close()
+        assert server.buffer.version == 0  # nothing was ever applied
+        np.testing.assert_allclose(server.buffer.get_numpy()["dense"]["w"], 1.0)
+    finally:
+        server.stop()
+
+
+def test_socket_replay_frame_rejected():
+    """A captured authenticated socket frame replayed verbatim must be
+    refused (nonce replay) without touching the buffer — an HMAC alone
+    authenticates the sender, not the occasion."""
+    import pickle
+    import socket as socket_mod
+    import struct
+    import time as time_mod
+
+    from elephas_tpu.utils import sockets as su
+
+    key = b"r" * 32
+    server = SocketServer(_params(), lock=True, port=0, auth_key=key)
+    server.start()
+    try:
+        delta = {
+            "dense": {"w": np.full((4, 4), 0.5, np.float32), "b": np.ones(4, np.float32)}
+        }
+        payload = pickle.dumps(("u", delta), protocol=pickle.HIGHEST_PROTOCOL)
+        header = b"\x07" * 16 + struct.pack("!d", time_mod.time())
+        body = header + payload
+        frame = struct.pack("!Q", len(body) + 32) + su.frame_mac(key, body) + body
+
+        def send_raw(expect_ok: bool) -> bool:
+            sock = socket_mod.create_connection(("127.0.0.1", server.port), timeout=5)
+            try:
+                sock.settimeout(5)
+                sock.sendall(frame)
+                try:
+                    su.receive(sock, key=key)  # server's "ok"
+                    return True
+                except (ConnectionError, OSError, socket_mod.timeout):
+                    return False
+            finally:
+                sock.close()
+
+        assert send_raw(True) is True  # first delivery applies
+        assert server.buffer.version == 1
+        assert send_raw(False) is False  # verbatim replay: refused
+        assert server.buffer.version == 1  # nothing double-applied
+    finally:
+        server.stop()
+
+
+def test_http_replay_request_rejected():
+    """Replaying a captured authenticated HTTP update (same nonce/ts/mac)
+    is a 403; the first delivery applied exactly once."""
+    import pickle
+    import time as time_mod
+    import urllib.error
+    import urllib.request
+
+    from elephas_tpu.utils import sockets as su
+
+    key = b"h" * 32
+    server = HttpServer(_params(), lock=True, port=0, auth_key=key)
+    server.start()
+    try:
+        delta = {
+            "dense": {"w": np.full((4, 4), 0.5, np.float32), "b": np.ones(4, np.float32)}
+        }
+        body = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)
+        nonce = b"\x09" * 16
+        ts = repr(time_mod.time())
+        mac = su.frame_mac(
+            key, b"POST" + b"/update" + nonce + ts.encode() + body
+        ).hex()
+
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/update", data=body, method="POST",
+                headers={"X-Elephas-Nonce": nonce.hex(), "X-Elephas-TS": ts,
+                         "X-Elephas-Auth": mac},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status
+
+        assert post() == 200
+        assert server.buffer.version == 1
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post()
+        assert err.value.code == 403
+        assert server.buffer.version == 1
+    finally:
+        server.stop()
+
+
 def test_local_server_shares_buffer():
     server = LocalServer(_params(), lock=False)
     client_a, client_b = server.client(), server.client()
