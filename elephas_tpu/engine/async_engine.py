@@ -151,16 +151,21 @@ class AsyncTrainer:
         if usable < n:
             spans.append((usable, n))
 
-        # Dispatch every chunk, then ONE device_get for all their metric
+        # Dispatch chunks, then ONE device_get for all their metric
         # dicts: a fetch per chunk costs a tunnel round-trip each (~0.1s
         # here), which made the overlapped epoch fire eval-RTT-bound.
+        # UNCACHED sets (> the cache byte bound) must still stream: the
+        # trailing fetch keeps at most ~2 chunk uploads in flight so a
+        # huge validation set never sits fully device-resident.
         device_metrics = []
-        for start, stop in spans:
+        for idx, (start, stop) in enumerate(spans):
             if cached is not None:
                 x, y = cached[0][start:stop], cached[1][start:stop]
             else:
                 x, y = jnp.asarray(features[start:stop]), jnp.asarray(labels[start:stop])
             device_metrics.append(self._local_eval_fn(state, x, y))
+            if cached is None and idx >= 1:
+                device_metrics[idx - 1] = jax.device_get(device_metrics[idx - 1])
         fetched = jax.device_get(device_metrics)
         return weighted_mean_over_chunks(
             [(s, e, i) for i, (s, e) in enumerate(spans)],

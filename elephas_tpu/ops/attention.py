@@ -73,11 +73,12 @@ def _blockwise_reference(q, k, v, causal: bool, block_q: int, block_k: int):
 
 
 # Below this sequence length the XLA blockwise path beats the Pallas
-# kernels on-chip (kernel-launch/tiling overhead dominates; measured
-# 2026-07-30 fwd+bwd: Pallas runs at 0.67x the XLA speed at 2048 —
-# i.e. XLA ~1.5x faster — while Pallas wins 1.4x at 4096 and 2.7x at
-# 8192 — `scripts/attention_bench.py`).
-_PALLAS_MIN_SEQ = 4096
+# kernels on-chip (kernel-launch/tiling overhead dominates). Re-measured
+# r4 with the per-length block tiling (default_blocks: 1024-row q tiles
+# up to 4k): Pallas 0.79x at 1024, 1.25x at 2048, 2.5x at 4096, 4.5x at
+# 8192 (`scripts/attention_bench.py`, 40 steps) — the wide tiles moved
+# the crossover down from r3's 4096.
+_PALLAS_MIN_SEQ = 2048
 
 
 def _on_tpu() -> bool:
@@ -140,7 +141,10 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: int = 512):
+def flash_attention(
+    q, k, v, causal: bool = True,
+    block_q: int | None = None, block_k: int | None = None,
+):
     """Blockwise attention with flash memory semantics at every length:
     the custom VJP recomputes attention weights in backward (never
     retaining O(seq^2) residuals), with the KERNEL chosen per length —
@@ -148,6 +152,14 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512, block_k: i
     backward wins (2.7x at 8k), XLA blockwise below, where Pallas
     launch/tiling overhead loses (scripts/attention_bench.py).
 
+    Block sizes default to the measured per-length tiling
+    (``attention_pallas.default_blocks``); pass explicitly to override.
     Differentiable. q/k/v: (batch, heads, seq, head_dim).
     """
+    if block_q is None or block_k is None:
+        from elephas_tpu.ops.attention_pallas import default_blocks
+
+        dq, dk = default_blocks(q.shape[2])
+        block_q = block_q if block_q is not None else dq
+        block_k = block_k if block_k is not None else dk
     return _flash(q, k, v, causal, block_q, block_k)

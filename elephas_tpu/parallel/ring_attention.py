@@ -40,9 +40,11 @@ import jax.numpy as jnp
 from elephas_tpu.parallel.mesh import SEQ_AXIS
 
 # Same crossover the single-device dispatch measured (ops/attention.py):
-# below ~4k tokens per shard the Pallas launch/tiling overhead loses to
-# XLA; at/above it the flash hop wins (scripts/attention_bench.py --ring).
-_PALLAS_MIN_SHARD = 4096
+# below ~2k tokens per shard the Pallas launch/tiling overhead loses to
+# XLA; at/above it the flash hop wins — 1.9x at 4k and 3.8x at 8k per
+# shard over the dense ring (scripts/attention_bench.py --ring, 40
+# steps, r4).
+_PALLAS_MIN_SHARD = 2048
 
 
 def require_seq_axis(axis_name: str = SEQ_AXIS):
@@ -160,9 +162,14 @@ def _pair_attn(q, k, v, causal: bool, use_pallas: bool):
     combination. Pallas flash kernel on TPU; an XLA reference with
     identical (o, lse) semantics elsewhere (CPU structure tests)."""
     if use_pallas:
-        from elephas_tpu.ops.attention_pallas import pallas_flash_attention
+        from elephas_tpu.ops.attention_pallas import (
+            default_blocks, pallas_flash_attention,
+        )
 
-        return pallas_flash_attention(q, k, v, causal=causal, return_lse=True)
+        bq, bk = default_blocks(q.shape[2])
+        return pallas_flash_attention(
+            q, k, v, causal=causal, block_q=bq, block_k=bk, return_lse=True
+        )
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
     scores = jnp.einsum(
@@ -188,9 +195,14 @@ def _pair_attn_bwd(q, k, v, o, lse, do, causal: bool, use_pallas: bool):
     (o, lse) residuals — p_ij = exp(s_ij - lse_i) is this hop's slice of
     the global softmax, so per-hop grads sum to the exact ring grads."""
     if use_pallas:
-        from elephas_tpu.ops.attention_pallas import pallas_flash_attention_bwd
+        from elephas_tpu.ops.attention_pallas import (
+            default_blocks, pallas_flash_attention_bwd,
+        )
 
-        return pallas_flash_attention_bwd(q, k, v, o, lse, do, causal=causal)
+        bq, bk = default_blocks(q.shape[2])
+        return pallas_flash_attention_bwd(
+            q, k, v, o, lse, do, causal=causal, block_q=bq, block_k=bk
+        )
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
     qf = q.astype(jnp.float32)

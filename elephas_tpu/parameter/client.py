@@ -154,11 +154,8 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
             headers = {"Content-Type": "application/octet-stream"} if payload else {}
             nonce = b""
             if self.auth_key is not None:
-                import os as _os
-                import time as _time
-
-                nonce = _os.urandom(16)
-                ts = repr(_time.time())
+                nonce = os.urandom(16)
+                ts = repr(time.time())
                 headers["X-Elephas-Nonce"] = nonce.hex()
                 headers["X-Elephas-TS"] = ts
                 headers["X-Elephas-Auth"] = socket_utils.frame_mac(

@@ -52,8 +52,11 @@ class ReplayGuard:
     bounded by pruning expired entries."""
 
     def __init__(self, window: float = 300.0):
+        from collections import deque
+
         self.window = window
-        self._seen: dict = {}  # nonce -> expiry
+        self._seen: set = set()
+        self._order = deque()  # (expiry, nonce) in arrival order
         self._lock = threading.Lock()
 
     def check(self, nonce: bytes, ts: float) -> None:
@@ -63,11 +66,15 @@ class ReplayGuard:
                 "authenticated frame outside the replay-freshness window"
             )
         with self._lock:
-            if len(self._seen) > 4096:
-                self._seen = {n: e for n, e in self._seen.items() if e > now}
+            # Amortized O(1): expiries arrive in order, so popping the
+            # stale front is all the pruning ever needed (a wholesale
+            # rebuild per frame would make a busy PS CPU-bound).
+            while self._order and self._order[0][0] <= now:
+                self._seen.discard(self._order.popleft()[1])
             if nonce in self._seen:
                 raise ConnectionError("replayed authenticated frame rejected")
-            self._seen[nonce] = now + self.window
+            self._seen.add(nonce)
+            self._order.append((now + self.window, nonce))
 
 
 def host_ip() -> str:

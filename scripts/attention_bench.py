@@ -35,14 +35,17 @@ def build(seq: int, impl: str, heads: int = 8, dim: int = 64, batch: int = 1):
         # 'xla_autodiff' is the plain-autodiff lower bound for context.
         import unittest.mock as mock
 
+        from elephas_tpu.ops.attention_pallas import default_blocks
+
+        bq, bk = default_blocks(q.shape[2])  # the SHIPPED per-length tiling
         if impl == "pallas":
             with mock.patch.object(attn, "_use_pallas", lambda q_: True):
-                out = attn._flash(q, k, v, True, 512, 512)
+                out = attn._flash(q, k, v, True, bq, bk)
         elif impl == "xla_custom_vjp":
             with mock.patch.object(attn, "_use_pallas", lambda q_: False):
-                out = attn._flash(q, k, v, True, 512, 512)
+                out = attn._flash(q, k, v, True, bq, bk)
         else:
-            out = attn._blockwise_reference(q, k, v, True, 512, 512)
+            out = attn._blockwise_reference(q, k, v, True, bq, bk)
         return jnp.sum(out.astype(jnp.float32) ** 2)
 
     grad_fn = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1, 2)))
@@ -105,10 +108,33 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", type=int, nargs="*", default=[2048, 4096, 8192])
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--ring", action="store_true",
+                    help="bench the ring arms (dense-hop vs flash-hop) "
+                         "at --seqs tokens/shard instead of the "
+                         "single-device kernels")
     args = ap.parse_args()
 
     print(f"devices={jax.devices()}", file=sys.stderr)
     by_seq = {}
+    if args.ring:
+        for seq in args.seqs:
+            for impl in ("dense", "flash"):
+                fn, data = build_ring(seq, impl)
+                sec = measure(fn, data, args.steps)
+                by_seq.setdefault(seq, {})[impl] = sec
+                print(json.dumps({
+                    "tokens_per_shard": seq, "ring_impl": impl,
+                    "fwd_bwd_ms": round(sec * 1e3, 2),
+                }), flush=True)
+                del fn, data
+        for seq, r in by_seq.items():
+            print(json.dumps({
+                "tokens_per_shard": seq,
+                "speedup_flash_ring_vs_dense_ring": round(
+                    r["dense"] / r["flash"], 2
+                ),
+            }), flush=True)
+        return
     for seq in args.seqs:
         for impl in ("xla_autodiff", "xla_custom_vjp", "pallas"):
             fn, data = build(seq, impl)
