@@ -6,8 +6,10 @@ the TPU rebuild. The attention implementation is pluggable:
 
 - ``attention='dense'`` — plain softmax attention (XLA-fused),
 - ``attention='flash'`` — Pallas blockwise kernel (``elephas_tpu.ops``),
-- sequence parallelism over a ``'seq'`` mesh axis is provided by
-  ``elephas_tpu.parallel.ring_attention`` at the engine level.
+- ``attention='ring'`` — sequence parallelism over the ``'seq'`` mesh
+  axis via K/V rotation (``elephas_tpu.parallel.ring_attention``),
+- ``attention='ulysses'`` — sequence parallelism via seq<->heads
+  all-to-all re-sharding (``elephas_tpu.parallel.ulysses``).
 """
 
 from __future__ import annotations
@@ -51,18 +53,35 @@ class SelfAttention(nn.Module):
             from elephas_tpu.ops.attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
-        elif self.attention == "ring" and not self.is_initializing():
+        elif (
+            self.attention in ("ring", "ulysses") and not self.is_initializing()
+        ):
             # Sequence-parallel: must be called inside shard_map with the
             # sequence dimension sharded over the 'seq' mesh axis (see
             # elephas_tpu.parallel.seq_parallel). During module init (which
             # runs outside shard_map, where the axis is unbound) the dense
             # path traces instead — attention has no parameters, so the
-            # param structure is identical.
-            from elephas_tpu.parallel.ring_attention import ring_attention
+            # param structure is identical. 'ring' rotates K/V shards;
+            # 'ulysses' re-shards seq<->heads with two all_to_alls and
+            # runs full-length flash attention per head subset.
+            if self.attention == "ring":
+                from elephas_tpu.parallel.ring_attention import ring_attention
 
-            out = ring_attention(q, k, v, causal=True)
-        else:
+                out = ring_attention(q, k, v, causal=True)
+            else:
+                from elephas_tpu.parallel.ulysses import ulysses_attention
+
+                out = ulysses_attention(q, k, v, causal=True)
+        elif self.attention in ("dense", "ring", "ulysses"):
             out = dense_causal_attention(q, k, v)
+        else:
+            # A silent dense fallback under sequence parallelism would
+            # compute shard-LOCAL attention — wrong math that still
+            # converges. Unknown names must fail loudly.
+            raise ValueError(
+                f"unknown attention={self.attention!r}; expected one of "
+                "'dense', 'flash', 'ring', 'ulysses'"
+            )
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(x.shape[0], x.shape[1], d_model)
         return nn.DenseGeneral(d_model, dtype=self.dtype, name="out")(out)
 
@@ -105,14 +124,16 @@ class TransformerLM(nn.Module):
             nn.initializers.normal(0.02),
             (self.max_seq_len, self.d_model),
         )
-        if self.attention == "ring" and not self.is_initializing():
+        if self.attention in ("ring", "ulysses") and not self.is_initializing():
             # Under sequence parallelism `tokens` is the local shard; index
             # the positional table at global positions.
             import jax
 
             from elephas_tpu.parallel.ring_attention import require_seq_axis
 
-            offset = require_seq_axis() * seq
+            offset = require_seq_axis(
+                feature=f"attention='{self.attention}'"
+            ) * seq
             x = (x + jax.lax.dynamic_slice_in_dim(pos, offset, seq, axis=0)).astype(
                 self.dtype
             )
@@ -135,6 +156,11 @@ def build_transformer_lm(
     dtype="float32",
     attention="dense",
 ):
+    if attention not in ("dense", "flash", "ring", "ulysses"):
+        raise ValueError(
+            f"unknown attention={attention!r}; expected one of "
+            "'dense', 'flash', 'ring', 'ulysses'"
+        )
     return TransformerLM(
         vocab_size=vocab_size,
         d_model=d_model,

@@ -47,18 +47,20 @@ from elephas_tpu.parallel.mesh import SEQ_AXIS
 _PALLAS_MIN_SHARD = 2048
 
 
-def require_seq_axis(axis_name: str = SEQ_AXIS):
+def require_seq_axis(axis_name: str = SEQ_AXIS, feature: str = "attention='ring'"):
     """``axis_index`` with an actionable error when called outside shard_map.
 
-    Ring attention only exists relative to a bound mesh axis; calling a
-    ring-configured model on an ordinary (unsharded) path would otherwise
-    surface as a cryptic unbound-axis NameError from deep in tracing.
+    Sequence-parallel attention only exists relative to a bound mesh
+    axis; calling a ring/ulysses-configured model on an ordinary
+    (unsharded) path would otherwise surface as a cryptic unbound-axis
+    NameError from deep in tracing. ``feature`` names the caller's
+    config in the error (also used by ``parallel.ulysses``).
     """
     try:
         return jax.lax.axis_index(axis_name)
     except NameError as exc:
         raise ValueError(
-            f"attention='ring' requires running inside shard_map with a "
+            f"{feature} requires running inside shard_map with a "
             f"'{axis_name}' mesh axis (see elephas_tpu.parallel.seq_parallel."
             f"make_lm_train_step). For single-device eval/predict, rebuild "
             f"the model with attention='dense' or 'flash' — the parameters "

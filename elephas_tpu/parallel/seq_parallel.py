@@ -8,8 +8,10 @@ compiled shard_map program per step — the sequence never materializes
 unsharded on any chip, so context length scales with the seq-axis size.
 
 The model must be a ``TransformerLM`` (or compatible) built with
-``attention='ring'`` so its attention rotates K/V and its positional
-embedding indexes global positions. The training step itself is the
+``attention='ring'`` (K/V rotation) or ``attention='ulysses'``
+(seq<->heads all-to-all — ``parallel.ulysses``) so its attention spans
+the sharded sequence and its positional embedding indexes global
+positions. The training step itself is the
 engine's standard ``make_train_step`` (same optimizer/metrics handling as
 every other mode) with a multi-axis pmean — the loss is whatever the
 ``CompiledModel`` was compiled with (use

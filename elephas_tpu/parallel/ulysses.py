@@ -1,0 +1,100 @@
+"""Ulysses-style (all-to-all) sequence-parallel attention.
+
+The second of the two standard long-context layouts (alongside
+``ring_attention`` — absent in the reference, SURVEY.md §5.7; the TPU
+rebuild treats long context as first-class). Instead of rotating K/V
+shards around the ring (n−1 ``ppermute`` hops), Ulysses re-shards with
+TWO ``all_to_all`` collectives per call (q/k/v ride one stacked gather;
+the output rides the scatter back):
+
+1. seq-sharded → head-sharded: each device trades its sequence shard of
+   every head for the FULL sequence of ``heads / seq_size`` heads;
+2. full-length causal attention runs locally per head subset — through
+   the length-aware ``flash_attention`` dispatch, so long sequences hit
+   the Pallas kernels on their natural (full-length) shapes;
+3. head-sharded → seq-sharded: the outputs trade back.
+
+Trade-offs vs the ring: all-to-all moves the same O(b·h·L·d/n) bytes
+per device but in one dense ICI shuffle instead of n−1 neighbor hops
+(fewer latency-bound steps, better for small n·large L); it requires
+``num_heads % seq_size == 0``; and attention compute runs at full
+sequence length locally (no per-hop skip — flash's causal tile skip
+recovers the 2× instead). Both layouts are exact attention; pick per
+topology. Differentiable end-to-end: ``all_to_all`` transposes to the
+reverse ``all_to_all`` under autodiff and ``flash_attention`` carries
+its own custom VJP — no hand-written backward needed.
+
+Usage: inside ``shard_map`` with q/k/v sharded P(batch?, heads?, 'seq',
+...) on the sequence dimension (``ulysses_self_attention`` wires the
+wrapper; ``TransformerLM(attention='ulysses')`` +
+``seq_parallel.make_lm_train_step`` is the trained path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from elephas_tpu.parallel.mesh import SEQ_AXIS
+from elephas_tpu.parallel.ring_attention import require_seq_axis
+
+
+def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True):
+    """Exact attention over a sequence-sharded mesh via head re-sharding.
+
+    q, k, v: local shards (batch, heads, local_len, head_dim); the global
+    sequence is the concatenation of shards in axis order. Returns the
+    local output shard, same shape. ``num_heads`` must divide evenly by
+    the seq-axis size.
+    """
+    require_seq_axis(axis_name, feature="attention='ulysses'")
+    n = jax.lax.axis_size(axis_name)
+    b, h, local_len, d = q.shape
+    if h % n != 0:
+        raise ValueError(
+            f"attention='ulysses' needs num_heads ({h}) divisible by the "
+            f"'{axis_name}' mesh axis size ({n}) — each device takes "
+            f"heads/seq_size full-length heads; use attention='ring' for "
+            f"head counts the mesh doesn't divide"
+        )
+    from elephas_tpu.ops.attention import flash_attention
+
+    if n == 1:
+        return flash_attention(q, k, v, causal=causal)
+
+    # ONE gather collective for q/k/v together (stacked), not three:
+    # collective-launch latency is the term this layout minimizes.
+    # (3, b, h, L/n, d) -> (3, b, h/n, L, d): give away head groups,
+    # collect the full sequence of our own group.
+    qkv = jax.lax.all_to_all(
+        jnp.stack((q, k, v)), axis_name, split_axis=2, concat_axis=3, tiled=True
+    )
+    qh, kh, vh = qkv[0], qkv[1], qkv[2]
+    # Full-length causal attention on our head subset; flash_attention's
+    # length dispatch sees the GLOBAL length, exactly where Pallas wins.
+    out = flash_attention(qh, kh, vh, causal=causal)
+    # inverse shuffle: (b, h/n, L, d) -> (b, h, L/n, d)
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def ulysses_self_attention(mesh, q, k, v, causal: bool = True):
+    """Convenience wrapper: shard_map Ulysses attention over ``mesh``'s
+    seq axis. q/k/v are global (batch, heads, seq, head_dim) arrays."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, SEQ_AXIS, None)
+
+    def body(q_, k_, v_):
+        return ulysses_attention(q_, k_, v_, axis_name=SEQ_AXIS, causal=causal)
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )(q, k, v)

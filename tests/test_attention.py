@@ -96,6 +96,73 @@ def test_flash_ring_matches_dense_ring(devices, causal):
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_dense(devices, causal):
+    """All-to-all sequence parallelism (4-way): head re-sharding + local
+    full-length attention must be exact attention, like the ring."""
+    from elephas_tpu.parallel.ulysses import ulysses_self_attention
+
+    mesh = build_mesh(num_data=1, num_seq=4)
+    q, k, v = _qkv(batch=2, heads=4, seq=64, dim=16, seed=7)
+    out = ulysses_self_attention(mesh, q, k, v, causal=causal)
+    if causal:
+        ref = dense_causal_attention(q, k, v)
+    else:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ulysses_grad_matches_ring(devices):
+    """Autodiff through the two all_to_alls + flash custom VJP equals the
+    ring path's gradients (both are exact attention)."""
+    from jax.sharding import PartitionSpec as P
+
+    from elephas_tpu.parallel.mesh import SEQ_AXIS
+    from elephas_tpu.parallel.ring_attention import ring_attention
+    from elephas_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = build_mesh(num_data=1, num_seq=4)
+    q, k, v = _qkv(batch=1, heads=4, seq=64, dim=8, seed=8)
+    spec = P(None, None, SEQ_AXIS, None)
+
+    def make_loss(fn):
+        def body(q_, k_, v_):
+            out = fn(q_, k_, v_, axis_name=SEQ_AXIS, causal=True)
+            return jax.lax.psum(jnp.sum(out.astype(jnp.float32) ** 2), SEQ_AXIS)
+
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=P(), check_vma=False)
+
+    g_u = jax.jit(jax.grad(make_loss(ulysses_attention), argnums=(0, 1, 2)))(q, k, v)
+    g_r = jax.jit(jax.grad(make_loss(ring_attention), argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_u, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_ulysses_rejects_indivisible_heads(devices):
+    from jax.sharding import PartitionSpec as P
+
+    from elephas_tpu.parallel.mesh import SEQ_AXIS
+    from elephas_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = build_mesh(num_data=1, num_seq=4)
+    q, k, v = _qkv(batch=1, heads=2, seq=64, dim=8, seed=9)  # 2 % 4 != 0
+    spec = P(None, None, SEQ_AXIS, None)
+
+    def run():
+        return jax.jit(
+            jax.shard_map(
+                lambda q_, k_, v_: ulysses_attention(q_, k_, v_),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,
+            )
+        )(q, k, v)
+
+    with pytest.raises(ValueError, match="divisible"):
+        run()
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_flash_ring_grad_matches_dense_ring(devices, causal):
     """The flash ring's custom VJP (rotating K/V + grad accumulators,
     per-hop dq/dk/dv from the global lse) must match autodiff through

@@ -16,13 +16,13 @@ from elephas_tpu.parallel.seq_parallel import (
 VOCAB, SEQ, BATCH = 64, 32, 4
 
 
-def _compiled(attention):
+def _compiled(attention, num_heads=2):
     return CompiledModel(
         get_model(
             "transformer_lm",
             vocab_size=VOCAB,
             d_model=32,
-            num_heads=2,
+            num_heads=num_heads,
             num_layers=2,
             max_seq_len=SEQ,
             attention=attention,
@@ -57,6 +57,37 @@ def test_seq_parallel_step_runs_and_learns(devices):
     assert int(state.step) == 10
 
 
+def test_seq_parallel_ulysses_step_runs_and_learns(devices):
+    """dp x sp with attention='ulysses' (all-to-all re-sharding) trains
+    through the same engine step as the ring path."""
+    mesh = build_mesh(num_data=2, num_seq=4)
+    compiled = _compiled("ulysses", num_heads=4)  # heads % seq_size == 0
+    step = make_lm_train_step(compiled, mesh)
+    state = init_lm_state(compiled, mesh)
+    tokens, targets = shard_lm_batch(mesh, *_data())
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, tokens, targets)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 10
+
+
+def test_ulysses_matches_ring_first_loss(devices):
+    """Both sequence-parallel layouts compute EXACT attention, so their
+    first-step losses coincide (identical init by construction)."""
+    mesh = build_mesh(num_data=2, num_seq=4)
+    tokens, targets = shard_lm_batch(mesh, *_data(seed=2))
+    losses = {}
+    for impl in ("ring", "ulysses"):
+        compiled = _compiled(impl, num_heads=4)
+        step = make_lm_train_step(compiled, mesh)
+        state = init_lm_state(compiled, mesh)
+        _, metrics = step(state, tokens, targets)
+        losses[impl] = float(metrics["loss"])
+    np.testing.assert_allclose(losses["ulysses"], losses["ring"], rtol=1e-4)
+
+
 def test_ring_model_outside_shard_map_fails_clearly(devices):
     import pytest
 
@@ -65,6 +96,25 @@ def test_ring_model_outside_shard_map_fails_clearly(devices):
         compiled.apply_eval(
             compiled.params, {}, jnp.zeros((1, SEQ), dtype=jnp.int32)
         )
+
+
+def test_ulysses_model_outside_shard_map_names_itself(devices):
+    import pytest
+
+    compiled = _compiled("ulysses", num_heads=4)
+    with pytest.raises(ValueError, match="attention='ulysses' requires"):
+        compiled.apply_eval(
+            compiled.params, {}, jnp.zeros((1, SEQ), dtype=jnp.int32)
+        )
+
+
+def test_unknown_attention_rejected_at_build():
+    import pytest
+
+    from elephas_tpu.models import get_model
+
+    with pytest.raises(ValueError, match="unknown attention"):
+        get_model("transformer_lm", attention="ulyses")  # typo must fail loudly
 
 
 def test_seq_parallel_matches_single_device_loss(devices):
