@@ -112,10 +112,15 @@ class AsyncTrainer:
         self.devices = [dev for _, dev in self.workers]
         self.n_workers = len(self.workers)  # local worker count
         self.n_global_workers = len(data_devices)
+        from elephas_tpu.utils.compiler import tpu_compiler_options
+
+        opts = tpu_compiler_options()
         self._train_step = make_train_step(compiled)
         self._subtract = jax.jit(subtract_params)
-        self._epoch_fn = jax.jit(make_epoch_scanner(self._train_step))
-        self._step_fn = jax.jit(self._train_step)
+        self._epoch_fn = jax.jit(
+            make_epoch_scanner(self._train_step), compiler_options=opts
+        )
+        self._step_fn = jax.jit(self._train_step, compiler_options=opts)
         self._local_eval_fn = None  # lazily-jitted single-device evaluator
         # Distinct, collision-free per-worker/per-step dropout streams.
         self._base_rng = jax.random.PRNGKey(977)
@@ -129,7 +134,12 @@ class AsyncTrainer:
         if self._local_eval_fn is None:
             from elephas_tpu.engine.step import DeviceEvalCache, make_eval_step
 
-            self._local_eval_fn = jax.jit(make_eval_step(self.compiled))
+            from elephas_tpu.utils.compiler import tpu_compiler_options
+
+            self._local_eval_fn = jax.jit(
+                make_eval_step(self.compiled),
+                compiler_options=tpu_compiler_options(),
+            )
             self._val_cache = DeviceEvalCache()
         from elephas_tpu.engine.step import weighted_mean_over_chunks
 

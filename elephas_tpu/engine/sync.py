@@ -23,6 +23,7 @@ there is no per-batch host round-trip, let alone the reference's
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -84,12 +85,16 @@ class SyncTrainer:
         self._epoch_fn = self._build_epoch_fn()
         # Jitted once here: wrapping per call would discard the trace cache
         # and retrace every epoch under validation_data (VERDICT r1 weak#1).
-        self._eval_fn = jax.jit(self._eval_step)
+        from elephas_tpu.utils.compiler import tpu_compiler_options
+
+        opts = tpu_compiler_options()
+        self._eval_fn = jax.jit(self._eval_step, compiler_options=opts)
         # Replicated predictions: the output would otherwise inherit the
         # input's DATA sharding, and fetching it on any one host would
         # touch non-addressable shards under multi-host (r3 #7).
         self._predict_fn = jax.jit(
-            self._predict_step, out_shardings=replicated_sharding(mesh)
+            self._predict_step, out_shardings=replicated_sharding(mesh),
+            compiler_options=opts,
         )
 
     # -- compiled bodies -------------------------------------------------------
@@ -146,7 +151,9 @@ class SyncTrainer:
         mesh = self.mesh
         data_spec = P(None, DATA_AXIS)  # (num_batches, global_batch, ...) axis 1
 
-        @jax.jit
+        from elephas_tpu.utils.compiler import tpu_compiler_options
+
+        @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
         def epoch_fn(state, xs, ys, epoch_idx):
             return jax.shard_map(
                 body,
@@ -463,6 +470,8 @@ class SyncTrainer:
             )
             return state, per_epoch
 
+        from elephas_tpu.utils.compiler import tpu_compiler_options
+
         data_spec = P(None, DATA_AXIS)
         fit_fn = jax.jit(
             jax.shard_map(
@@ -471,7 +480,8 @@ class SyncTrainer:
                 in_specs=(P(), data_spec, data_spec),
                 out_specs=(P(), P()),
                 check_vma=False,
-            )
+            ),
+            compiler_options=tpu_compiler_options(),
         )
         state, per_epoch = fit_fn(state, xs, ys)
         per_epoch = jax.device_get(per_epoch)

@@ -56,6 +56,8 @@ def make_lm_train_step(compiled, mesh):
         )
         return new_state, metrics
 
+    from elephas_tpu.utils.compiler import tpu_compiler_options
+
     token_spec = P(DATA_AXIS, SEQ_AXIS)
     step = jax.jit(
         jax.shard_map(
@@ -64,7 +66,8 @@ def make_lm_train_step(compiled, mesh):
             in_specs=(P(), token_spec, token_spec),
             out_specs=(P(), P()),
             check_vma=False,
-        )
+        ),
+        compiler_options=tpu_compiler_options(),
     )
     return step
 

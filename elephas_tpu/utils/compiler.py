@@ -1,0 +1,51 @@
+"""Backend compile options for the hot jitted programs.
+
+One measured knob so far: ``xla_tpu_scoped_vmem_limit_kib``. Raising
+XLA's scoped-VMEM budget from its default to 96 MiB bought a consistent
++4–5% on the flagship ResNet-18 train step (33.8k → 35.4k samples/sec,
+2×40-step repeats, r4 sweep — other candidate options measured at noise
+level), by giving fusions deeper VMEM buffering. Verified compatible
+with the Pallas flash-attention kernels (their scratch is declared per
+``pallas_call``, not from this scope): the 8k flash fwd+bwd and a
+4k-seq flash LM train step both compile and run under the option.
+
+``$ELEPHAS_SCOPED_VMEM_KIB`` overrides the budget; ``0`` disables the
+option entirely (compile with backend defaults — the escape hatch if a
+future model's VMEM footprint collides).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger("elephas_tpu")
+
+_DEFAULT_KIB = 98304  # 96 MiB — r4 sweep winner on v5-lite
+
+
+def tpu_compiler_options() -> Optional[dict]:
+    """Compiler options for jitting hot train/eval programs.
+
+    Returns None off-TPU (and when disabled with ``0``), so CPU tests
+    and other backends compile exactly as before. A malformed override
+    falls back to the default WITH a warning — silently dropping the
+    option would be a quiet ~4–5% regression with nothing in the logs.
+    """
+    if jax.default_backend() != "tpu":
+        return None
+    kib = os.environ.get("ELEPHAS_SCOPED_VMEM_KIB", str(_DEFAULT_KIB))
+    try:
+        value = int(kib)
+    except ValueError:
+        logger.warning(
+            "ELEPHAS_SCOPED_VMEM_KIB=%r is not an integer; using the "
+            "default %d KiB (set 0 to disable)", kib, _DEFAULT_KIB,
+        )
+        value = _DEFAULT_KIB
+    if value <= 0:
+        return None
+    return {"xla_tpu_scoped_vmem_limit_kib": str(value)}

@@ -42,7 +42,14 @@ def build_step(norm_dtype: str, batch: int, input_dtype: str):
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
     device = jax.devices()[0]
     x, y = jax.device_put(x, device), jax.device_put(y, device)
-    step = jax.jit(make_train_step(compiled), donate_argnums=(0,))
+    from elephas_tpu.utils.compiler import tpu_compiler_options
+
+    # Same compile options as bench.py/the shipped trainers — the sweep
+    # must measure the program production actually runs.
+    step = jax.jit(
+        make_train_step(compiled), donate_argnums=(0,),
+        compiler_options=tpu_compiler_options(),
+    )
     state = jax.device_put(init_train_state(compiled), device)
     return step, state, x, y
 

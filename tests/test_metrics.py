@@ -53,3 +53,31 @@ def test_throughput_blocks_on_device_wall():
 def test_host0_logger_singleton():
     logger = host0_logger("elephas_test")
     logger.info("hello")  # no assertion — just must not raise
+
+
+def test_tpu_compiler_options_gating(monkeypatch):
+    """Off-TPU -> None (tests/CPU compile untouched); env overrides and
+    0 disables on TPU."""
+    import jax
+
+    from elephas_tpu.utils import compiler
+
+    assert jax.default_backend() != "tpu"
+    assert compiler.tpu_compiler_options() is None  # CPU harness
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert compiler.tpu_compiler_options() == {
+        "xla_tpu_scoped_vmem_limit_kib": "98304"
+    }
+    monkeypatch.setenv("ELEPHAS_SCOPED_VMEM_KIB", "65536")
+    assert compiler.tpu_compiler_options() == {
+        "xla_tpu_scoped_vmem_limit_kib": "65536"
+    }
+    monkeypatch.setenv("ELEPHAS_SCOPED_VMEM_KIB", "0")
+    assert compiler.tpu_compiler_options() is None
+    # Malformed override: warn and keep the default rather than silently
+    # dropping the measured win.
+    monkeypatch.setenv("ELEPHAS_SCOPED_VMEM_KIB", "96MiB")
+    assert compiler.tpu_compiler_options() == {
+        "xla_tpu_scoped_vmem_limit_kib": "98304"
+    }
