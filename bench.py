@@ -62,9 +62,10 @@ def measure_train_rate(batch_size: int, steps: int, warmup: int, dtype: str) -> 
 
     from elephas_tpu.utils.compiler import tpu_compiler_options
 
-    # The engine's production compile options (scoped-VMEM bump, +4-5%
-    # measured on this step — utils/compiler.py); the bench measures
-    # what the shipped trainers actually run.
+    # Same compile options as the shipped trainers (backend defaults
+    # unless the user opts into the scoped-VMEM knob — utils/compiler.py
+    # documents why it is not a default): the bench measures what
+    # production actually runs.
     step = jax.jit(
         make_train_step(compiled), donate_argnums=(0,),
         compiler_options=tpu_compiler_options(),

@@ -1,17 +1,25 @@
 """Backend compile options for the hot jitted programs.
 
-One measured knob so far: ``xla_tpu_scoped_vmem_limit_kib``. Raising
-XLA's scoped-VMEM budget from its default to 96 MiB bought a consistent
-+4–5% on the flagship ResNet-18 train step (33.8k → 35.4k samples/sec,
-2×40-step repeats, r4 sweep — other candidate options measured at noise
-level), by giving fusions deeper VMEM buffering. Verified compatible
-with the Pallas flash-attention kernels (their scratch is declared per
-``pallas_call``, not from this scope): the 8k flash fwd+bwd and a
-4k-seq flash LM train step both compile and run under the option.
+One measured knob so far: ``xla_tpu_scoped_vmem_limit_kib`` (reachable
+only via ``jax.jit(..., compiler_options=...)`` — this build's
+``XLA_FLAGS`` parser rejects TPU flags). The r4 sweep measured raising
+the scoped-VMEM budget to 96 MiB at **+4–5% on the bare flagship
+ResNet-18 train step** (33.8k → 35.3k samples/sec, repeated 40-step
+runs) — but a per-workload A/B on the real parity fits showed it is NOT
+a safe default:
 
-``$ELEPHAS_SCOPED_VMEM_KIB`` overrides the budget; ``0`` disables the
-option entirely (compile with backend defaults — the escape hatch if a
-future model's VMEM footprint collides).
+| workload (full fit, steady) | default | 96 MiB |
+|---|---|---|
+| CIFAR ResNet-18 hogwild | 33.3k | 32.1k (−3%) |
+| MNIST CNN async         | 65.1k | 65.5k (neutral) |
+| IMDB LSTM estimator     | 34.9k | **19.9k (−43%)** |
+
+The scan-heavy LSTM regresses catastrophically, and the gains on the
+bare conv step do not survive the real fit. The knob therefore ships
+OPT-IN: set ``$ELEPHAS_SCOPED_VMEM_KIB`` (e.g. ``98304``) to apply it
+to every hot program (train/eval/predict across all trainers, bench,
+and sweeps — they share this helper so measurements match production);
+unset or ``0`` compiles with backend defaults.
 """
 
 from __future__ import annotations
@@ -24,28 +32,27 @@ import jax
 
 logger = logging.getLogger("elephas_tpu")
 
-_DEFAULT_KIB = 98304  # 96 MiB — r4 sweep winner on v5-lite
-
 
 def tpu_compiler_options() -> Optional[dict]:
     """Compiler options for jitting hot train/eval programs.
 
-    Returns None off-TPU (and when disabled with ``0``), so CPU tests
-    and other backends compile exactly as before. A malformed override
-    falls back to the default WITH a warning — silently dropping the
-    option would be a quiet ~4–5% regression with nothing in the logs.
+    None (backend defaults) unless ``$ELEPHAS_SCOPED_VMEM_KIB`` opts in;
+    always None off-TPU. A malformed value warns and is ignored rather
+    than silently changing compile behavior.
     """
     if jax.default_backend() != "tpu":
         return None
-    kib = os.environ.get("ELEPHAS_SCOPED_VMEM_KIB", str(_DEFAULT_KIB))
+    kib = os.environ.get("ELEPHAS_SCOPED_VMEM_KIB")
+    if not kib:
+        return None
     try:
         value = int(kib)
     except ValueError:
         logger.warning(
-            "ELEPHAS_SCOPED_VMEM_KIB=%r is not an integer; using the "
-            "default %d KiB (set 0 to disable)", kib, _DEFAULT_KIB,
+            "ELEPHAS_SCOPED_VMEM_KIB=%r is not an integer; compiling with "
+            "backend defaults", kib,
         )
-        value = _DEFAULT_KIB
+        return None
     if value <= 0:
         return None
     return {"xla_tpu_scoped_vmem_limit_kib": str(value)}
