@@ -1,0 +1,139 @@
+"""Tensor parallelism for the transformer LM (GSPMD sharding rules).
+
+The third mesh axis (``'model'``) the mesh has reserved since r1, made
+real the idiomatic XLA way: no hand-written collectives — parameters
+get ``NamedSharding`` annotations (Megatron-style: attention heads and
+MLP hidden column-sharded, their output projections row-sharded, vocab
+embedding/head vocab-sharded), inputs get the data sharding, and GSPMD
+propagates the layout and inserts the all-reduces itself ("pick a mesh,
+annotate shardings, let XLA insert collectives" — the scaling-book
+recipe the rebuild is designed around). Composes with data parallelism
+on the same mesh: ``build_mesh(num_data=D, num_model=M)``.
+
+Scope note: the reference has NO model parallelism of any kind
+(SURVEY.md §2.2 — data-parallel only); this module is a beyond-parity
+capability like the sequence-parallel layouts, aimed at models whose
+parameters outgrow one chip. Sequence parallelism (ring/ulysses) covers
+the long-SEQUENCE regime; this covers the wide-MODEL regime. The two
+use different step builders today (shard_map vs GSPMD jit).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elephas_tpu.engine.state import TrainState
+from elephas_tpu.engine.step import init_train_state, make_train_step
+from elephas_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+# Path-pattern -> PartitionSpec for TransformerLM parameters (paths are
+# '/'-joined flax dict keys; kernels listed with their array layouts).
+_LM_RULES = (
+    # qkv DenseGeneral: kernel (d_model, 3, heads, head_dim) — shard heads.
+    (r".*/qkv/kernel$", P(None, None, MODEL_AXIS, None)),
+    (r".*/qkv/bias$", P(None, MODEL_AXIS, None)),
+    # attention output projection: kernel (d_model, d_model) — row-parallel
+    # (contracting dim sharded; GSPMD inserts the psum).
+    (r".*/out/kernel$", P(MODEL_AXIS, None)),
+    (r".*/out/bias$", P()),
+    # MLP: first Dense column-parallel, second row-parallel.
+    (r".*/Dense_0/kernel$", P(None, MODEL_AXIS)),
+    (r".*/Dense_0/bias$", P(MODEL_AXIS)),
+    (r".*/Dense_1/kernel$", P(MODEL_AXIS, None)),
+    (r".*/Dense_1/bias$", P()),
+    # Vocabulary-sharded embedding and LM head.
+    (r".*tok_embed/embedding$", P(MODEL_AXIS, None)),
+    (r".*lm_head/kernel$", P(None, MODEL_AXIS)),
+    (r".*lm_head/bias$", P(MODEL_AXIS)),
+)
+
+
+def _spec_for(path: str) -> P:
+    for pattern, spec in _LM_RULES:
+        if re.match(pattern, path):
+            return spec
+    return P()  # LayerNorms, pos_embed, scalars: replicated
+
+
+def lm_param_specs(params) -> Dict:
+    """PartitionSpec pytree for a ``TransformerLM`` parameter tree."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_str(kp):
+        return "/".join(str(getattr(k, "key", k)) for k in kp)
+
+    specs = {path_str(kp): _spec_for(path_str(kp)) for kp, _ in flat}
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [specs[path_str(kp)] for kp, _ in flat]
+    )
+
+
+def _state_shardings(mesh: Mesh, state: TrainState) -> TrainState:
+    """NamedShardings for the full TrainState: params per the TP rules,
+    optimizer slots following their parameter's layout, everything else
+    replicated. ``state`` may be real arrays OR ``jax.eval_shape``
+    ShapeDtypeStructs — only tree structure is inspected.
+
+    Slots are matched STRUCTURALLY: any opt_state subtree whose pytree
+    structure equals the param tree's (optax's mu/nu/trace mirrors) gets
+    the param specs wholesale — matching by array shape would silently
+    missharde slots whenever two different params share a shape (e.g.
+    pos_embed vs a (d, d) projection)."""
+    param_specs = lm_param_specs(state.params)
+    params_treedef = jax.tree_util.tree_structure(state.params)
+
+    def is_param_tree(node):
+        try:
+            return jax.tree_util.tree_structure(node) == params_treedef
+        except Exception:
+            return False
+
+    opt_specs = jax.tree_util.tree_map(
+        lambda node: param_specs
+        if is_param_tree(node)
+        else jax.tree_util.tree_map(lambda _: P(), node),
+        state.opt_state,
+        is_leaf=is_param_tree,
+    )
+    spec_state = jax.tree_util.tree_map(lambda _: P(), state).replace(
+        params=param_specs, opt_state=opt_specs
+    )
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        spec_state,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def make_lm_train_step_tp(compiled, mesh: Mesh):
+    """Build ``step(state, tokens, targets)`` jitted with dp×tp GSPMD
+    shardings: batch over ``'data'``, parameters over ``'model'`` per
+    ``_LM_RULES``. Use ``init_lm_state_tp`` for a state already placed
+    on the mesh; tokens/targets may be plain host arrays (jit shards
+    them)."""
+    from elephas_tpu.utils.compiler import tpu_compiler_options
+
+    # Shapes only — never materialize a throwaway state (this module
+    # exists for params that may not fit one host comfortably).
+    abstract = jax.eval_shape(lambda: init_train_state(compiled))
+    state_sh = _state_shardings(mesh, abstract)
+    data_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+    return jax.jit(
+        make_train_step(compiled),
+        in_shardings=(state_sh, data_sh, data_sh),
+        out_shardings=(state_sh, NamedSharding(mesh, P())),
+        compiler_options=tpu_compiler_options(),
+    )
+
+
+def init_lm_state_tp(compiled, mesh: Mesh, rng=None) -> TrainState:
+    """TrainState with parameters/optimizer slots PLACED per the TP
+    rules (the sharded-from-birth path a too-big-for-one-chip model
+    needs; here init is tiny so a host init + device_put is fine)."""
+    state = init_train_state(compiled, rng=rng)
+    return jax.device_put(state, _state_shardings(mesh, state))

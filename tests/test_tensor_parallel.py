@@ -1,0 +1,106 @@
+"""dp x tp GSPMD training tests: param-sharded transformer LM."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.models import get_model
+from elephas_tpu.parallel.mesh import MODEL_AXIS, build_mesh
+from elephas_tpu.parallel.tensor_parallel import (
+    init_lm_state_tp,
+    lm_param_specs,
+    make_lm_train_step_tp,
+)
+
+VOCAB, SEQ, BATCH = 64, 32, 8
+
+
+def _compiled():
+    return CompiledModel(
+        get_model(
+            "transformer_lm",
+            vocab_size=VOCAB,
+            d_model=32,
+            num_heads=4,
+            num_layers=2,
+            max_seq_len=SEQ,
+            attention="dense",
+        ),
+        optimizer={"name": "adam", "learning_rate": 1e-2},
+        loss="sparse_categorical_crossentropy",
+        metrics=[],
+        input_shape=(SEQ,),
+        input_dtype=jnp.int32,
+        seed=0,
+    )
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, VOCAB, size=(BATCH, SEQ + 1), dtype=np.int32)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def test_tp_specs_cover_all_params():
+    """Every sharded-rule family actually matches the LM's tree: heads,
+    MLP hidden, and vocab dims carry the 'model' axis; norms replicated."""
+    compiled = _compiled()
+    specs = lm_param_specs(compiled.params)
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in kp): spec
+        for kp, spec in jax.tree_util.tree_flatten_with_path(specs)[0]
+    }
+    def uses_model_axis(spec):
+        return any(
+            e == MODEL_AXIS or (isinstance(e, tuple) and MODEL_AXIS in e)
+            for e in spec
+        )
+
+    sharded = [p for p, s in flat.items() if uses_model_axis(s)]
+    assert any("qkv/kernel" in p for p in sharded)
+    assert any("Dense_0/kernel" in p for p in sharded)
+    assert any("tok_embed" in p for p in sharded)
+    assert any("lm_head/kernel" in p for p in sharded)
+    assert all("LayerNorm" not in p for p in sharded)
+
+
+def test_tp_step_runs_learns_and_places_shards(devices):
+    """2x4 dp x tp mesh: the GSPMD step trains, and the big kernels are
+    genuinely SHARDED over the model axis (per-device shard is 1/4)."""
+    mesh = build_mesh(num_data=2, num_model=4)
+    compiled = _compiled()
+    step = make_lm_train_step_tp(compiled, mesh)
+    state = init_lm_state_tp(compiled, mesh)
+
+    qkv = state.params["Block_0"]["SelfAttention_0"]["qkv"]["kernel"]
+    shard_shape = qkv.sharding.shard_shape(qkv.shape)
+    assert shard_shape[2] == qkv.shape[2] // 4  # heads dim split 4-way
+
+    tokens, targets = _data()
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, tokens, targets)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert int(state.step) == 10
+
+
+def test_tp_matches_single_device_loss(devices):
+    """First-step loss under dp x tp equals the unsharded loss — the
+    sharding annotations change layout, never math."""
+    mesh = build_mesh(num_data=2, num_model=4)
+    compiled = _compiled()
+    step = make_lm_train_step_tp(compiled, mesh)
+    state = init_lm_state_tp(compiled, mesh)
+    tokens, targets = _data(seed=1)
+    _, metrics = step(state, tokens, targets)
+    tp_loss = float(metrics["loss"])
+
+    ref = _compiled()
+    logits = ref.apply_eval(ref.params, {}, jnp.asarray(tokens))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ref_loss = float(
+        -np.mean(np.take_along_axis(np.asarray(logp), targets[..., None], axis=-1))
+    )
+    np.testing.assert_allclose(tp_loss, ref_loss, rtol=1e-4)
