@@ -86,6 +86,43 @@ def test_tp_step_runs_learns_and_places_shards(devices):
     assert int(state.step) == 10
 
 
+def test_tp_state_checkpoint_roundtrip(devices, tmp_path):
+    """A TP-sharded TrainState saves and restores WITH its shardings
+    (Orbax handles sharded jax.Arrays natively), and training continues
+    from the restored state — the wide-model resume path."""
+    from elephas_tpu.checkpoint import CheckpointManager
+
+    mesh = build_mesh(num_data=2, num_model=4)
+    compiled = _compiled()
+    step = make_lm_train_step_tp(compiled, mesh)
+    state = init_lm_state_tp(compiled, mesh)
+    tokens, targets = _data(seed=2)
+    for _ in range(3):
+        state, _ = step(state, tokens, targets)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=2)
+    mgr.save(state, block=True)
+    mgr.close()
+
+    # Restore into a fresh concrete sharded template (a second init
+    # would train identically but for the 3 saved steps, so the
+    # equality assert below proves restore really loaded the snapshot).
+    mgr2 = CheckpointManager(str(tmp_path / "ckpts"))
+    restored = mgr2.restore(init_lm_state_tp(compiled, mesh))
+    mgr2.close()
+    assert int(restored.step) == 3
+    qkv = restored.params["Block_0"]["SelfAttention_0"]["qkv"]["kernel"]
+    assert qkv.sharding.shard_shape(qkv.shape)[2] == qkv.shape[2] // 4
+    np.testing.assert_array_equal(
+        np.asarray(qkv),
+        np.asarray(state.params["Block_0"]["SelfAttention_0"]["qkv"]["kernel"]),
+    )
+    # The restored state steps without resharding errors.
+    restored, metrics = step(restored, tokens, targets)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(restored.step) == 4
+
+
 def test_tp_matches_single_device_loss(devices):
     """First-step loss under dp x tp equals the unsharded loss — the
     sharding annotations change layout, never math."""
