@@ -18,6 +18,7 @@ import sys
 import time
 import urllib.request
 
+import numpy as np
 import pytest
 
 _CHILD = """
@@ -144,6 +145,124 @@ def test_two_process_training_all_modes(tmp_path, mode, ps_mode, stream):
     assert len(results[0]["val_acc"]) == 3
     assert results[0]["val_acc"] == results[1]["val_acc"]
     assert results[0]["val_loss"] == results[1]["val_loss"]
+
+
+_SPTP_CHILD = """
+import os, sys
+idx, nproc, coord, kind = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=idx)
+assert jax.device_count() == 4 * nproc, jax.device_count()
+
+import json
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.models import get_model
+from elephas_tpu.parallel.mesh import DATA_AXIS, build_mesh
+
+rng = np.random.default_rng(0)
+if kind in ("ring", "ulysses"):
+    # dp x sp: 'data' axis SPANS the two processes (DCN), 'seq' axis is
+    # host-local (ICI) — ppermute / all_to_all ride the intra-host ring,
+    # gradient pmean crosses hosts, per the mesh-layout convention
+    # (parallel/mesh.py module docstring).
+    from elephas_tpu.parallel.seq_parallel import (
+        init_lm_state, make_lm_train_step, shard_lm_batch,
+    )
+    num_seq = 4
+    mesh = build_mesh(num_data=2, num_seq=num_seq)
+    seq = 8 * num_seq
+    compiled = CompiledModel(
+        get_model("transformer_lm", vocab_size=64, d_model=16, num_heads=4,
+                  num_layers=1, max_seq_len=seq, attention=kind),
+        optimizer={"name": "adam", "learning_rate": 1e-2},
+        loss="sparse_categorical_crossentropy",
+        metrics=[], input_shape=(seq,), input_dtype=jnp.int32, seed=0,
+    )
+    step = make_lm_train_step(compiled, mesh)
+    state = init_lm_state(compiled, mesh)
+    tokens = rng.integers(0, 64, size=(4, seq + 1), dtype=np.int32)
+    x, t = shard_lm_batch(mesh, tokens[:, :-1], tokens[:, 1:])
+else:  # kind == "tp": dp x tp GSPMD with Megatron-style param shardings
+    from elephas_tpu.parallel.tensor_parallel import (
+        init_lm_state_tp, make_lm_train_step_tp,
+    )
+    num_model = 4
+    mesh = build_mesh(num_data=2, num_model=num_model)
+    compiled = CompiledModel(
+        get_model("transformer_lm", vocab_size=32 * num_model,
+                  d_model=8 * num_model, num_heads=num_model, num_layers=1,
+                  max_seq_len=16, attention="dense"),
+        optimizer={"name": "adam", "learning_rate": 1e-2},
+        loss="sparse_categorical_crossentropy",
+        metrics=[], input_shape=(16,), input_dtype=jnp.int32, seed=0,
+    )
+    step = make_lm_train_step_tp(compiled, mesh)
+    state = init_lm_state_tp(compiled, mesh)
+    tokens = rng.integers(0, 32 * num_model, size=(4, 17), dtype=np.int32)
+    sh = NamedSharding(mesh, P(DATA_AXIS, None))
+    x = jax.device_put(tokens[:, :-1], sh)
+    t = jax.device_put(tokens[:, 1:], sh)
+
+losses = []
+for _ in range(5):
+    state, metrics = step(state, x, t)
+    losses.append(float(metrics["loss"]))
+assert int(state.step) == 5
+print("RESULT " + json.dumps({"proc": idx, "losses": losses}))
+"""
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses", "tp"])
+def test_two_process_seq_and_tensor_parallel(tmp_path, kind):
+    """The beyond-parity parallelism paths crossing REAL process
+    boundaries (VERDICT r4 #1): dp x sp LM steps (ring ppermute and
+    ulysses all_to_all layouts) and the dp x tp GSPMD LM step each run on
+    a 2-process x 4-virtual-device global mesh via ``jax.distributed`` —
+    process-spanning ``jax.Array``s, per-host addressable shards, DCN in
+    the gradient-reduction path. Both ranks must observe IDENTICAL finite
+    losses and a step of learning."""
+    script = tmp_path / "child.py"
+    script.write_text(_SPTP_CHILD)
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", coord, kind],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    results = {}
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, f"child failed:\n{out}\n{err[-3000:]}"
+            for line in out.splitlines():
+                if line.startswith("RESULT "):
+                    rec = json.loads(line[len("RESULT "):])
+                    results[rec["proc"]] = rec
+    finally:
+        # One child failing fast must not orphan its peer (it would spin
+        # in jax.distributed heartbeats holding the coordinator port).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=30)
+    assert set(results) == {0, 1}
+    # SPMD: every rank computes the same global program — losses must be
+    # bitwise identical across processes, finite, and decreasing (the
+    # fixed batch is memorized).
+    assert results[0]["losses"] == results[1]["losses"]
+    losses = results[0]["losses"]
+    assert all(np.isfinite(v) for v in losses)
+    assert losses[-1] < losses[0]
 
 
 _HYPERPARAM_CHILD = """
