@@ -21,6 +21,7 @@ reference, where the driver averages weights, never optimizer slots).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -38,6 +39,8 @@ from elephas_tpu.parameter.server import make_server
 from elephas_tpu.utils.functional_utils import subtract_params
 
 _FREQUENCIES = ("batch", "epoch")
+
+logger = logging.getLogger("elephas_tpu")
 
 
 @jax.jit
@@ -332,6 +335,7 @@ class AsyncTrainer:
         fire_queue: deque = deque()
         fire_stop = [False]
         fire_errors: List[BaseException] = []
+        saturated_warned = [False]
         val_records: List[Optional[Dict[str, float]]] = [None] * epochs
 
         def pull_snapshot():
@@ -349,7 +353,11 @@ class AsyncTrainer:
 
         def do_fire(fire: int, snapshot=None) -> None:
             t0 = time.perf_counter()
-            if snapshot is None:
+            stale = snapshot is None
+            if stale:
+                # The drainer fell behind and this epoch's boundary
+                # snapshot was never pinned: validation/callbacks see the
+                # PS as of NOW, not as of the epoch boundary.
                 snapshot = pull_snapshot()
             mark_phase("fire_snapshot", t0, snapshot["params"])
             if snap_opt_state[0] is None:
@@ -371,9 +379,12 @@ class AsyncTrainer:
                 # to the PS device — feeding them to the SPMD evaluator
                 # would mix committed placements and fail under jit.
                 t0 = time.perf_counter()
-                val_records[fire] = self._local_evaluate(
-                    snap_state, *validation_data
-                )
+                rec = dict(self._local_evaluate(snap_state, *validation_data))
+                # Honest metrics (SURVEY.md §5.5): a user must be able to
+                # tell from history whether this epoch's val row sampled
+                # the epoch boundary or a later (stale-fire) PS state.
+                rec["stale"] = 1.0 if stale else 0.0
+                val_records[fire] = rec
                 mark_phase("fire_val", t0)
             t0 = time.perf_counter()
             for cb in run_callbacks:
@@ -402,8 +413,21 @@ class AsyncTrainer:
                     # later in the drainer. If the drainer falls behind
                     # (slow user callback), stop pinning snapshots and
                     # let those fires pull at fire time — bounded HBM
-                    # over honesty in the already-degenerate case.
-                    snapshot = pull_snapshot() if len(fire_queue) < 3 else None
+                    # over honesty in the already-degenerate case. The
+                    # degradation is SURFACED: warn once, and each
+                    # affected epoch's val row carries val_stale=1.
+                    saturated = len(fire_queue) >= 3
+                    if saturated and not saturated_warned[0]:
+                        saturated_warned[0] = True
+                        logger.warning(
+                            "epoch-fire queue saturated at epoch %d (slow "
+                            "callback/validation?): snapshots are no longer "
+                            "pinned at epoch boundaries — affected epochs' "
+                            "validations sample a LATER parameter-server "
+                            "state and are marked val_stale=1 in history",
+                            epochs_fired,
+                        )
+                    snapshot = None if saturated else pull_snapshot()
                     fire_queue.append((epochs_fired, snapshot))
                     self.epoch_end_times.append(time.perf_counter())
                     epochs_fired += 1
@@ -590,7 +614,10 @@ class AsyncTrainer:
             for epoch, val in enumerate(records):
                 if val is None:
                     if fallback is None:
-                        fallback = self._local_evaluate(state, *validation_data)
+                        fallback = dict(
+                            self._local_evaluate(state, *validation_data)
+                        )
+                        fallback["stale"] = 1.0  # final state, not the epoch's
                     records[epoch] = fallback
             return records
 
@@ -742,7 +769,18 @@ class AsyncTrainer:
             would serialize the chip queue the pipeline exists to keep
             full (VERDICT r1 weak#4) — so device faults there surface at
             the epoch-boundary fetch, outside the retry; the per-batch
-            retry covers host- and wire-side faults."""
+            retry covers host- and wire-side faults.
+
+            Delivery semantics (advisor r4): this layer is AT-LEAST-ONCE.
+            The wire clients never re-send an in-flight write, but if a
+            unit fails AFTER its push was applied server-side (e.g. the
+            response read errors with something other than
+            ParameterServerUnavailable), the retry re-runs the whole
+            unit from a fresh pull and pushes a SECOND delta for the
+            same batch/epoch. Benign for SGD — the duplicate is one more
+            small stochastic step, same class of noise as hogwild's
+            racing writers — and the push is the LAST fallible op in
+            each unit, so the window is exactly the response handling."""
             nonlocal epoch_retries
             for attempt in range(self.max_failures):
                 try:

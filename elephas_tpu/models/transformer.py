@@ -9,7 +9,13 @@ the TPU rebuild. The attention implementation is pluggable:
 - ``attention='ring'`` — sequence parallelism over the ``'seq'`` mesh
   axis via K/V rotation (``elephas_tpu.parallel.ring_attention``),
 - ``attention='ulysses'`` — sequence parallelism via seq<->heads
-  all-to-all re-sharding (``elephas_tpu.parallel.ulysses``).
+  all-to-all re-sharding (``elephas_tpu.parallel.ulysses``),
+- ``attention='auto'`` — topology-driven: under a bound ``'seq'`` mesh
+  axis picks ulysses when the head count divides the axis (one dense
+  shuffle instead of n−1 ring hops) and ring otherwise (works for ANY
+  head count); outside shard_map falls back to the length-dispatched
+  flash kernel. All choices are exact attention, so 'auto' is safe as
+  a default — the user never has to know the topology math.
 """
 
 from __future__ import annotations
@@ -49,12 +55,24 @@ class SelfAttention(nn.Module):
         q = jnp.transpose(q, (0, 2, 1, 3))
         k = jnp.transpose(k, (0, 2, 1, 3))
         v = jnp.transpose(v, (0, 2, 1, 3))
-        if self.attention == "flash":
+        attention = self.attention
+        if attention == "auto" and not self.is_initializing():
+            # Resolved at trace time (axis size is static): sequence-
+            # parallel layout by topology under a bound 'seq' axis, flash
+            # dispatch otherwise. Exact attention either way.
+            from elephas_tpu.parallel.ring_attention import seq_axis_size_or_none
+
+            n = seq_axis_size_or_none()
+            if n is None:
+                attention = "flash"
+            else:
+                attention = "ulysses" if self.num_heads % n == 0 else "ring"
+        if attention == "flash":
             from elephas_tpu.ops.attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True)
         elif (
-            self.attention in ("ring", "ulysses") and not self.is_initializing()
+            attention in ("ring", "ulysses") and not self.is_initializing()
         ):
             # Sequence-parallel: must be called inside shard_map with the
             # sequence dimension sharded over the 'seq' mesh axis (see
@@ -64,7 +82,7 @@ class SelfAttention(nn.Module):
             # param structure is identical. 'ring' rotates K/V shards;
             # 'ulysses' re-shards seq<->heads with two all_to_alls and
             # runs full-length flash attention per head subset.
-            if self.attention == "ring":
+            if attention == "ring":
                 from elephas_tpu.parallel.ring_attention import ring_attention
 
                 out = ring_attention(q, k, v, causal=True)
@@ -72,7 +90,7 @@ class SelfAttention(nn.Module):
                 from elephas_tpu.parallel.ulysses import ulysses_attention
 
                 out = ulysses_attention(q, k, v, causal=True)
-        elif self.attention in ("dense", "ring", "ulysses"):
+        elif attention in ("dense", "ring", "ulysses", "auto"):
             out = dense_causal_attention(q, k, v)
         else:
             # A silent dense fallback under sequence parallelism would
@@ -80,7 +98,7 @@ class SelfAttention(nn.Module):
             # converges. Unknown names must fail loudly.
             raise ValueError(
                 f"unknown attention={self.attention!r}; expected one of "
-                "'dense', 'flash', 'ring', 'ulysses'"
+                "'dense', 'flash', 'ring', 'ulysses', 'auto'"
             )
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(x.shape[0], x.shape[1], d_model)
         return nn.DenseGeneral(d_model, dtype=self.dtype, name="out")(out)
@@ -124,13 +142,21 @@ class TransformerLM(nn.Module):
             nn.initializers.normal(0.02),
             (self.max_seq_len, self.d_model),
         )
-        if self.attention in ("ring", "ulysses") and not self.is_initializing():
+        import jax
+
+        from elephas_tpu.parallel.ring_attention import (
+            require_seq_axis,
+            seq_axis_size_or_none,
+        )
+
+        seq_parallel = self.attention in ("ring", "ulysses") or (
+            # 'auto' is sequence-parallel exactly when a 'seq' axis is
+            # bound (mirrors SelfAttention's trace-time resolution).
+            self.attention == "auto" and seq_axis_size_or_none() is not None
+        )
+        if seq_parallel and not self.is_initializing():
             # Under sequence parallelism `tokens` is the local shard; index
             # the positional table at global positions.
-            import jax
-
-            from elephas_tpu.parallel.ring_attention import require_seq_axis
-
             offset = require_seq_axis(
                 feature=f"attention='{self.attention}'"
             ) * seq
@@ -156,10 +182,10 @@ def build_transformer_lm(
     dtype="float32",
     attention="dense",
 ):
-    if attention not in ("dense", "flash", "ring", "ulysses"):
+    if attention not in ("dense", "flash", "ring", "ulysses", "auto"):
         raise ValueError(
             f"unknown attention={attention!r}; expected one of "
-            "'dense', 'flash', 'ring', 'ulysses'"
+            "'dense', 'flash', 'ring', 'ulysses', 'auto'"
         )
     return TransformerLM(
         vocab_size=vocab_size,

@@ -47,6 +47,16 @@ from elephas_tpu.parallel.mesh import SEQ_AXIS
 _PALLAS_MIN_SHARD = 2048
 
 
+def seq_axis_size_or_none(axis_name: str = SEQ_AXIS):
+    """Size of the bound sequence-parallel mesh axis, or None when not
+    running inside shard_map (single-device eval/predict, init traces).
+    The static int drives ``attention='auto'``'s layout choice."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except NameError:
+        return None
+
+
 def require_seq_axis(axis_name: str = SEQ_AXIS, feature: str = "attention='ring'"):
     """``axis_index`` with an actionable error when called outside shard_map.
 

@@ -55,7 +55,8 @@ def ulysses_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True):
             f"attention='ulysses' needs num_heads ({h}) divisible by the "
             f"'{axis_name}' mesh axis size ({n}) — each device takes "
             f"heads/seq_size full-length heads; use attention='ring' for "
-            f"head counts the mesh doesn't divide"
+            f"head counts the mesh doesn't divide, or attention='auto' to "
+            f"have the layout picked from the topology"
         )
     from elephas_tpu.ops.attention import flash_attention
 

@@ -19,6 +19,10 @@ socket, failures raise immediately — a re-sent write would double-apply
 a delta or double-count a teardown-barrier arrival (tearing the PS down
 under a peer mid-pull), and retrying a read timeout on an established
 connection would stall ``timeout``-per-attempt against a wedged server.
+NOTE this no-resend guarantee is the WIRE layer's only: the engine's
+task-retry layer above (``AsyncTrainer`` ``run_unit``) re-runs a failed
+frequency-unit end to end, so delta application is at-least-once
+job-wide — see the run_unit docstring for why that is sound for SGD.
 """
 
 from __future__ import annotations
@@ -292,8 +296,11 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
         for retry in (idempotent, False):
             sock = self._connection()
             try:
-                socket_utils.send(sock, frame, key=self.auth_key)
-                return socket_utils.receive(sock, key=self.auth_key)
+                nonce = socket_utils.send(sock, frame, key=self.auth_key)
+                # Reply MAC is bound to OUR request nonce (mirrors the
+                # HTTP transport): a captured server response can't be
+                # replayed into a different exchange.
+                return socket_utils.receive(sock, key=self.auth_key, bind=nonce)
             except (socket.timeout, TimeoutError) as exc:
                 # Read timeout on an ESTABLISHED connection: the server is
                 # wedged, not restarting — another ``timeout``-long attempt
@@ -344,8 +351,8 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
             sock = socket.create_connection(self._addr, timeout=_CONNECT_TIMEOUT)
             try:
                 sock.settimeout(_CONNECT_TIMEOUT)
-                socket_utils.send(sock, ("c", "health"), key=self.auth_key)
-                socket_utils.receive(sock, key=self.auth_key)
+                nonce = socket_utils.send(sock, ("c", "health"), key=self.auth_key)
+                socket_utils.receive(sock, key=self.auth_key, bind=nonce)
             finally:
                 sock.close()
             return True

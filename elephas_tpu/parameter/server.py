@@ -257,19 +257,26 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                 # With auth_key set, receive() verifies the frame's HMAC
                 # and replay-freshness BEFORE unpickling; a bad tag or a
                 # replayed nonce raises ConnectionError and the
-                # connection closes without touching the buffer.
-                kind, payload = socket_utils.receive(
-                    self.request, key=key, replay_guard=guard
+                # connection closes without touching the buffer. Replies
+                # are MAC-bound to the request's nonce (advisor r4) so a
+                # captured response can't be replayed into a later
+                # exchange — the client verifies with the nonce it sent.
+                (kind, payload), req_nonce = socket_utils.receive(
+                    self.request, key=key, replay_guard=guard, return_nonce=True
                 )
+
+                def reply(obj):
+                    socket_utils.send(self.request, obj, key=key, bind=req_nonce)
+
                 if kind == "g":
-                    socket_utils.send(self.request, buffer.get_numpy(), key=key)
+                    reply(buffer.get_numpy())
                 elif kind == "u":
                     buffer.apply_delta(payload)
-                    socket_utils.send(self.request, b"ok", key=key)
+                    reply(b"ok")
                 elif kind == "b":  # barrier arrive(tag) -> count
-                    socket_utils.send(self.request, barriers.arrive(payload), key=key)
+                    reply(barriers.arrive(payload))
                 elif kind == "c":  # barrier count(tag)
-                    socket_utils.send(self.request, barriers.count(payload), key=key)
+                    reply(barriers.count(payload))
                 else:
                     break
         except (ConnectionError, OSError):

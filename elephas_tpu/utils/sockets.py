@@ -66,15 +66,22 @@ class ReplayGuard:
                 "authenticated frame outside the replay-freshness window"
             )
         with self._lock:
-            # Amortized O(1): expiries arrive in order, so popping the
-            # stale front is all the pruning ever needed (a wholesale
-            # rebuild per frame would make a busy PS CPU-bound).
+            # Amortized O(1): expiries arrive ROUGHLY in order, so popping
+            # the stale front is all the pruning ever needed (a wholesale
+            # rebuild per frame would make a busy PS CPU-bound). A frame
+            # from a fast-clocked sender can append a later expiry than
+            # its successors, which only DELAYS pruning of those entries
+            # (bounded by the window) — never drops a live nonce early.
             while self._order and self._order[0][0] <= now:
                 self._seen.discard(self._order.popleft()[1])
             if nonce in self._seen:
                 raise ConnectionError("replayed authenticated frame rejected")
             self._seen.add(nonce)
-            self._order.append((now + self.window, nonce))
+            # Retain until the frame could no longer pass the freshness
+            # check above (advisor r4): a sender whose clock is AHEAD by S
+            # passes freshness until ts + window, so expiring its nonce at
+            # now + window would open an S-second replay gap.
+            self._order.append((max(now, ts) + self.window, nonce))
 
 
 def host_ip() -> str:
@@ -113,19 +120,28 @@ def determine_master(port: int = 4000) -> str:
     return f"{host_ip()}:{port}"
 
 
-def send(sock: socket.socket, obj, key: bytes | None = None) -> None:
+def send(
+    sock: socket.socket, obj, key: bytes | None = None, bind: bytes = b""
+) -> bytes:
     """Pickle ``obj`` and send it with an 8-byte length prefix; with
     ``key``, the frame is [mac32][nonce16][ts8][payload] with the
-    HMAC-SHA256 tag covering nonce+ts+payload (see ``ReplayGuard``)."""
+    HMAC-SHA256 tag covering bind+nonce+ts+payload (see ``ReplayGuard``).
+
+    ``bind`` mixes extra context under the MAC without shipping it —
+    servers bind replies to the REQUEST's nonce so a captured response
+    can't be replayed into a later exchange (the receiver must pass the
+    same ``bind``). Returns this frame's nonce (b"" when keyless) so
+    callers can bind the reply they are about to read."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     if key is not None:
-        header = os.urandom(_NONCE_LEN) + _TS.pack(time.time())
-        body = header + payload
+        nonce = os.urandom(_NONCE_LEN)
+        body = nonce + _TS.pack(time.time()) + payload
         sock.sendall(
-            _LEN.pack(len(body) + _MAC_LEN) + frame_mac(key, body) + body
+            _LEN.pack(len(body) + _MAC_LEN) + frame_mac(key, bind + body) + body
         )
-    else:
-        sock.sendall(_LEN.pack(len(payload)) + payload)
+        return nonce
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+    return b""
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -143,20 +159,24 @@ def receive(
     sock: socket.socket,
     key: bytes | None = None,
     replay_guard: ReplayGuard | None = None,
+    bind: bytes = b"",
+    return_nonce: bool = False,
 ):
     """Receive one length-prefixed pickled object (inverse of ``send``).
 
     With ``key``, the frame's HMAC tag is verified BEFORE unpickling —
     unauthenticated or tampered bytes never reach ``pickle.loads``.
     ``replay_guard`` (servers) additionally rejects duplicate/stale
-    nonces under the MAC."""
+    nonces under the MAC. ``bind`` must match the sender's (clients pass
+    their request nonce when reading the reply). ``return_nonce=True``
+    returns ``(obj, nonce)`` so servers can bind their reply."""
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     data = _recv_exact(sock, length)
     if key is not None:
         if length < _MAC_LEN + _AUTH_HDR_LEN:
             raise ConnectionError("authenticated frame shorter than its header")
         tag, body = data[:_MAC_LEN], data[_MAC_LEN:]
-        if not hmac.compare_digest(tag, frame_mac(key, body)):
+        if not hmac.compare_digest(tag, frame_mac(key, bind + body)):
             raise ConnectionError(
                 "wire-frame authentication failed (bad or missing HMAC)"
             )
@@ -164,5 +184,7 @@ def receive(
         (ts,) = _TS.unpack(body[_NONCE_LEN:_AUTH_HDR_LEN])
         if replay_guard is not None:
             replay_guard.check(nonce, ts)
-        return pickle.loads(body[_AUTH_HDR_LEN:])
-    return pickle.loads(data)
+        obj = pickle.loads(body[_AUTH_HDR_LEN:])
+        return (obj, nonce) if return_nonce else obj
+    obj = pickle.loads(data)
+    return (obj, b"") if return_nonce else obj

@@ -154,7 +154,8 @@ def test_socket_replay_frame_rejected():
                 sock.settimeout(5)
                 sock.sendall(frame)
                 try:
-                    su.receive(sock, key=key)  # server's "ok"
+                    # server's "ok" — reply MAC is bound to OUR nonce
+                    su.receive(sock, key=key, bind=b"\x07" * 16)
                     return True
                 except (ConnectionError, OSError, socket_mod.timeout):
                     return False
@@ -167,6 +168,59 @@ def test_socket_replay_frame_rejected():
         assert server.buffer.version == 1  # nothing double-applied
     finally:
         server.stop()
+
+
+def test_socket_response_bound_to_request_nonce():
+    """Socket replies are MAC-bound to the request's nonce (advisor r4,
+    mirroring the HTTP transport): the same reply bytes verify under the
+    request nonce and FAIL verification under any other — so a captured
+    response can't be replayed into a later exchange."""
+    import socket as socket_mod
+
+    from elephas_tpu.utils import sockets as su
+
+    key = b"b" * 32
+    server = SocketServer(_params(), lock=True, port=0, auth_key=key)
+    server.start()
+    try:
+        sock = socket_mod.create_connection(("127.0.0.1", server.port), timeout=5)
+        try:
+            sock.settimeout(5)
+            nonce = su.send(sock, ("c", "tag"), key=key)
+            assert len(nonce) == 16
+            # Capture the raw reply and check the MAC binding directly.
+            import struct
+
+            (length,) = struct.unpack("!Q", su._recv_exact(sock, 8))
+            data = su._recv_exact(sock, length)
+            tag, body = data[:32], data[32:]
+            assert tag == su.frame_mac(key, nonce + body)  # bound to request
+            assert tag != su.frame_mac(key, body)  # unbound check fails
+            assert tag != su.frame_mac(key, b"\x01" * 16 + body)  # other nonce
+        finally:
+            sock.close()
+    finally:
+        server.stop()
+
+
+def test_replay_guard_future_timestamp_retention():
+    """A frame whose sender clock runs AHEAD stays replay-protected for
+    its WHOLE freshness life (advisor r4): the nonce must be retained
+    until ts + window, not receipt + window — otherwise the frame
+    replays in the gap after its nonce is pruned but before freshness
+    expires."""
+    import time as time_mod
+
+    from elephas_tpu.utils.sockets import ReplayGuard
+
+    guard = ReplayGuard(window=300.0)
+    ahead = time_mod.time() + 200  # sender clock 200s fast: still fresh
+    guard.check(b"n" * 16, ahead)
+    # The expiry must outlive receipt+window whenever ts > receipt.
+    expiry = guard._order[-1][0]
+    assert expiry >= ahead + 300.0 - 1.0
+    with pytest.raises(ConnectionError, match="replayed"):
+        guard.check(b"n" * 16, ahead)
 
 
 def test_http_replay_request_rejected():

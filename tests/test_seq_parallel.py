@@ -108,6 +108,81 @@ def test_ulysses_model_outside_shard_map_names_itself(devices):
         )
 
 
+def test_auto_attention_picks_ring_for_undividable_heads(devices, monkeypatch):
+    """attention='auto' (VERDICT r4 #9): an LM whose head count does NOT
+    divide the 4-way seq axis (6 % 4 != 0) trains without the user
+    choosing a layout — auto falls back to ring (exact for any head
+    count). The ring path is asserted via a trace-time call counter."""
+    import elephas_tpu.parallel.ring_attention as ra
+
+    calls = {"ring": 0}
+    real = ra.ring_attention
+
+    def counting(*args, **kwargs):
+        calls["ring"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(ra, "ring_attention", counting)
+
+    mesh = build_mesh(num_data=2, num_seq=4)
+    compiled = CompiledModel(
+        get_model(
+            "transformer_lm", vocab_size=VOCAB, d_model=24, num_heads=6,
+            num_layers=1, max_seq_len=SEQ, attention="auto",
+        ),
+        optimizer={"name": "adam", "learning_rate": 1e-2},
+        loss="sparse_categorical_crossentropy",
+        metrics=[], input_shape=(SEQ,), input_dtype=jnp.int32, seed=0,
+    )
+    step = make_lm_train_step(compiled, mesh)
+    state = init_lm_state(compiled, mesh)
+    tokens, targets = shard_lm_batch(mesh, *_data())
+    losses = []
+    for _ in range(10):
+        state, metrics = step(state, tokens, targets)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert calls["ring"] > 0  # auto resolved to the ring layout
+
+
+def test_auto_attention_picks_ulysses_when_heads_divide(devices, monkeypatch):
+    """With heads % seq_size == 0, auto picks the ulysses layout (one
+    all-to-all shuffle beats n-1 ring hops) — counted at trace time."""
+    import elephas_tpu.parallel.ulysses as ul
+
+    calls = {"ulysses": 0}
+    real = ul.ulysses_attention
+
+    def counting(*args, **kwargs):
+        calls["ulysses"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(ul, "ulysses_attention", counting)
+
+    mesh = build_mesh(num_data=2, num_seq=4)
+    compiled = _compiled("auto", num_heads=4)
+    step = make_lm_train_step(compiled, mesh)
+    state = init_lm_state(compiled, mesh)
+    tokens, targets = shard_lm_batch(mesh, *_data())
+    _, metrics = step(state, tokens, targets)
+    assert np.isfinite(float(metrics["loss"]))
+    assert calls["ulysses"] > 0
+
+
+def test_auto_attention_outside_shard_map_is_flash(devices):
+    """Outside shard_map 'auto' is NOT an error (unlike ring/ulysses):
+    it resolves to the flash dispatch, so the same model object serves
+    single-device eval/predict, matching dense numerics."""
+    auto = _compiled("auto", num_heads=4)
+    dense = _compiled("dense", num_heads=4)
+    tokens, _ = _data(seed=5)
+    out_auto = auto.apply_eval(auto.params, {}, jnp.asarray(tokens))
+    out_dense = dense.apply_eval(dense.params, {}, jnp.asarray(tokens))
+    np.testing.assert_allclose(
+        np.asarray(out_auto), np.asarray(out_dense), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_unknown_attention_rejected_at_build():
     import pytest
 
