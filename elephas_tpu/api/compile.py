@@ -74,6 +74,15 @@ def resolve_optimizer(optimizer) -> Tuple[optax.GradientTransformation, Optional
     Keras-style name, or ``{"name": ..., **kwargs}`` where
     ``learning_rate`` may be a float or a ``{"schedule": ...}`` config
     (see ``resolve_schedule``).
+
+    ``"injected": True`` wraps the optimizer in
+    ``optax.inject_hyperparams``: numeric hyperparameters (the learning
+    rate above all) become ``opt_state`` ARRAYS instead of baked trace
+    constants, so models differing only in lr lower to IDENTICAL
+    programs. Hyperparameter trials then share compiled executables
+    across lr samples (VERDICT r4 #6 — a fresh XLA compile per lr is
+    pure warm-up waste; see ``models.mlp.MaskedMLP`` for the width
+    half of that trade).
     """
     if isinstance(optimizer, str):
         spec = {"name": optimizer}
@@ -85,9 +94,13 @@ def resolve_optimizer(optimizer) -> Tuple[optax.GradientTransformation, Optional
     if name not in OPTIMIZERS:
         raise ValueError(f"unknown optimizer {name!r}; known: {sorted(OPTIMIZERS)}")
     builder, defaults = OPTIMIZERS[name]
+    inject = bool(spec.pop("injected", False))
     kwargs = {**defaults, **spec}
     build_kwargs = dict(kwargs)
     build_kwargs["learning_rate"] = resolve_schedule(build_kwargs["learning_rate"])
+    if inject:
+        transform = optax.inject_hyperparams(builder)(**build_kwargs)
+        return transform, {"name": name, "injected": True, **kwargs}
     return builder(**build_kwargs), {"name": name, **kwargs}
 
 

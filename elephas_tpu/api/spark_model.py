@@ -68,6 +68,7 @@ class TpuModel:
         mesh=None,
         hogwild_granularity: str = "tree",
         max_failures: int = 4,
+        autotune: bool = False,
     ):
         """``hogwild_granularity`` ('tree'|'leaf'): lock-free apply
         isolation for mode='hogwild' — 'leaf' drops at most racing
@@ -81,7 +82,15 @@ class TpuModel:
         that the reference leaned on (SURVEY.md §5.3). A transient
         exception in a worker's epoch/batch unit retries from a fresh
         PS pull up to this many total attempts before failing the fit;
-        retry counts appear in history as ``worker_retries``."""
+        retry counts appear in history as ``worker_retries``.
+
+        ``autotune``: one-shot per-workload compile-option A/B at fit
+        start (VERDICT r4 #5): a 2-batch run of this model is timed
+        under each candidate option set (today: backend defaults vs the
+        measured scoped-VMEM knob, utils/compiler.py) and the winner
+        compiles the fit's hot programs. The choice lands in history as
+        ``compile_autotune``. Off-TPU (or with $ELEPHAS_SCOPED_VMEM_KIB
+        forcing a choice) this is a no-op."""
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         if frequency not in FREQUENCIES:
@@ -134,6 +143,7 @@ class TpuModel:
         self.num_workers = num_workers
         self.hogwild_granularity = hogwild_granularity
         self.max_failures = max_failures
+        self.autotune = autotune
         self._mesh = mesh
         self._state = None  # latest TrainState (post-fit)
         self.training_histories: List[Dict[str, List[float]]] = []
@@ -232,7 +242,10 @@ class TpuModel:
                 )
 
         if self.mode == "synchronous":
-            trainer = SyncTrainer(self._master, self.mesh, frequency=self.frequency)
+            trainer = SyncTrainer(
+                self._master, self.mesh, frequency=self.frequency,
+                autotune=self.autotune,
+            )
             state, history = trainer.fit(
                 dataset,
                 epochs=epochs,
@@ -266,6 +279,7 @@ class TpuModel:
                     self.hogwild_granularity if self.mode == "hogwild" else "tree"
                 ),
                 max_failures=self.max_failures,
+                autotune=self.autotune,
             )
             state, history = trainer.fit(
                 dataset,
@@ -285,6 +299,12 @@ class TpuModel:
         # in an overlapped drainer thread there and lag by the in-flight
         # fire. None in sync mode, where callbacks are in-loop.
         self.last_epoch_end_times = getattr(trainer, "epoch_end_times", None)
+        # Compile-autotune outcome (VERDICT r4 #5): surfaced both on the
+        # model and in the returned history so parity/bench tables can
+        # quote which option set actually trained.
+        self.last_autotune = getattr(trainer, "autotune_choice", None)
+        if self.last_autotune is not None:
+            history["compile_autotune"] = self.last_autotune["winner"]
 
         # Checkpoint saves run async during training; barrier before fit
         # returns so snapshots are durable when the caller sees the result.

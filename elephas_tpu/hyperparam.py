@@ -33,7 +33,31 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import numpy as np
 
-__all__ = ["hp", "HyperParamModel", "sample_space", "current_trial_device"]
+__all__ = [
+    "hp", "HyperParamModel", "sample_space", "current_trial_device",
+    "width_bucket",
+]
+
+
+def width_bucket(width: int, buckets) -> int:
+    """Smallest bucket >= ``width`` — the executable-sharing quantizer.
+
+    XLA compiles one executable per SHAPE, so a width search that builds
+    models at every sampled width pays a full compile per fresh width
+    (~12s on the dev chip, parity_results.jsonl). Building instead at
+    ``width_bucket(w, buckets)`` with the true width masked
+    (``models.mlp.MaskedMLP``, or any model taking a bucket+active
+    pair) means only bucket boundaries ever compile; combined with an
+    ``"injected"`` optimizer (api.compile.resolve_optimizer) the whole
+    search shares len(buckets) executables.
+    """
+    for b in sorted(int(b) for b in buckets):
+        if width <= b:
+            return b
+    raise ValueError(
+        f"width {width} exceeds the largest bucket {max(buckets)} — "
+        "add a bucket at least as large as the search space's maximum"
+    )
 
 _trial_ctx = threading.local()
 

@@ -5,6 +5,7 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+import jax.numpy as jnp
 
 from elephas_tpu.models import register_model
 
@@ -31,3 +32,63 @@ class MLP(nn.Module):
 @register_model("mlp")
 def build_mlp(features=(128, 128), num_classes=10, dropout_rate=0.0):
     return MLP(features=tuple(features), num_classes=num_classes, dropout_rate=dropout_rate)
+
+
+class MaskedMLP(nn.Module):
+    """Width-bucketed MLP: layers are built at ``features`` (bucket)
+    width but only the first ``active[i]`` units of layer *i* are live.
+
+    The point is EXECUTABLE SHARING across hyperparameter trials
+    (VERDICT r4 #6): XLA compiles per shape, so a width search over
+    {64, 128, 256} pays a full ~12s recompile per fresh width. Here the
+    jitted program is shaped on the bucket only — the active-width mask
+    lives in the ``batch_stats`` collection, entering the program as a
+    runtime ARRAY argument, so every width in a bucket runs the same
+    executable and only bucket boundaries ever compile.
+
+    Exactness: padded units' activations are multiplied by a 0/1 mask,
+    so they contribute nothing forward and receive zero gradient —
+    parameters, optimizer moments, and the loss trajectory behave as a
+    true ``active``-width network (the padded columns just ride along
+    at their init values). The compute cost is the bucket's, the
+    statistics are the active width's — the standard padding trade.
+    """
+
+    features: Sequence[int] = (128,)
+    active: Sequence[int] = (128,)
+    num_classes: int = 10
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if len(self.active) != len(self.features):
+            raise ValueError(
+                f"active widths {self.active} must match bucket layout "
+                f"{self.features} layer-for-layer"
+            )
+        x = x.reshape((x.shape[0], -1))
+        for i, (bucket, live) in enumerate(zip(self.features, self.active)):
+            if not 0 < live <= bucket:
+                raise ValueError(
+                    f"layer {i}: active width {live} outside (0, {bucket}]"
+                )
+            mask = self.variable(
+                "batch_stats",
+                f"mask_{i}",
+                lambda: (jnp.arange(bucket) < live).astype(jnp.float32),
+            )
+            x = nn.Dense(bucket)(x)
+            x = nn.relu(x) * mask.value
+            if self.dropout_rate > 0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+@register_model("mlp_masked")
+def build_masked_mlp(features=(128,), active=None, num_classes=10, dropout_rate=0.0):
+    return MaskedMLP(
+        features=tuple(features),
+        active=tuple(active) if active is not None else tuple(features),
+        num_classes=num_classes,
+        dropout_rate=dropout_rate,
+    )

@@ -72,13 +72,23 @@ def _blockwise_reference(q, k, v, causal: bool, block_q: int, block_k: int):
     return out[:, :, :sq]
 
 
-# Below this sequence length the XLA blockwise path beats the Pallas
-# kernels on-chip (kernel-launch/tiling overhead dominates). Re-measured
-# r4 with the per-length block tiling (default_blocks: 1024-row q tiles
-# up to 4k): Pallas 0.79x at 1024, 1.25x at 2048, 2.5x at 4096, 4.5x at
-# 8192 (`scripts/attention_bench.py`, 40 steps) — the wide tiles moved
-# the crossover down from r3's 4096.
-_PALLAS_MIN_SEQ = 2048
+def pallas_min_seq(head_dim: int) -> int:
+    """Sequence length above which the Pallas kernels beat the XLA
+    blockwise path, as a function of head_dim (VERDICT r4 #7 — the r4
+    constant was tuned on head_dim 64 only).
+
+    Measured r5 on the dev chip (`scripts/attention_bench.py --dims 32
+    64 128`, 40–80 steps, fwd+bwd): at seq 2048 the two paths are
+    within tunnel noise of parity for EVERY measured head_dim (0.74×–
+    1.25× across repeated runs); at ≥3072 Pallas wins clearly (1.4×–
+    2.3×) and keeps growing (4×–5× at 8192); at ≤1024 XLA wins. The
+    crossover therefore sits between 2k and 3k regardless of head_dim
+    in [32, 128] — the threshold stays 2048 there (worst case is
+    noise-level parity on one marginal shape, and every longer length
+    wins). Head dims beyond the measured range fall back to a
+    conservative 4096 so an unmeasured tiling can't silently regress.
+    """
+    return 2048 if head_dim <= 128 else 4096
 
 
 def _on_tpu() -> bool:
@@ -86,7 +96,7 @@ def _on_tpu() -> bool:
 
 
 def _use_pallas(q) -> bool:
-    return _on_tpu() and q.shape[2] >= _PALLAS_MIN_SEQ
+    return _on_tpu() and q.shape[2] >= pallas_min_seq(q.shape[3])
 
 
 def _forward_impl(q, k, v, causal, block_q, block_k):
@@ -147,9 +157,9 @@ def flash_attention(
 ):
     """Blockwise attention with flash memory semantics at every length:
     the custom VJP recomputes attention weights in backward (never
-    retaining O(seq^2) residuals), with the KERNEL chosen per length —
-    Pallas on TPU for seq >= ``_PALLAS_MIN_SEQ`` where its fused
-    backward wins (2.7x at 8k), XLA blockwise below, where Pallas
+    retaining O(seq^2) residuals), with the KERNEL chosen per shape —
+    Pallas on TPU for seq >= ``pallas_min_seq(head_dim)`` where its
+    fused backward wins (4-5x at 8k), XLA blockwise below, where Pallas
     launch/tiling overhead loses (scripts/attention_bench.py).
 
     Block sizes default to the measured per-length tiling

@@ -39,12 +39,12 @@ import jax.numpy as jnp
 
 from elephas_tpu.parallel.mesh import SEQ_AXIS
 
-# Same crossover the single-device dispatch measured (ops/attention.py):
-# below ~2k tokens per shard the Pallas launch/tiling overhead loses to
+# The per-hop kernel crossover follows the single-device dispatch
+# (ops/attention.pallas_min_seq, now a function of head_dim — VERDICT
+# r4 #7): below it per SHARD the Pallas launch/tiling overhead loses to
 # XLA; at/above it the flash hop wins — 1.9x at 4k and 3.8x at 8k per
 # shard over the dense ring (scripts/attention_bench.py --ring, 40
-# steps, r4).
-_PALLAS_MIN_SHARD = 2048
+# steps, r4; head_dim sweep r5 in ops/attention.py).
 
 
 def seq_axis_size_or_none(axis_name: str = SEQ_AXIS):
@@ -87,15 +87,18 @@ def ring_attention(
     the global sequence is the concatenation of shards in axis order.
     Returns the local output shard (batch, heads, local_len, head_dim).
 
-    ``impl``: 'auto' (flash on TPU at >= _PALLAS_MIN_SHARD tokens/shard,
-    dense otherwise), 'dense', or 'flash' (XLA pair kernels off-TPU, for
-    structure tests). Differentiable on every path.
+    ``impl``: 'auto' (flash on TPU at >= ``pallas_min_seq(head_dim)``
+    tokens/shard, dense otherwise), 'dense', or 'flash' (XLA pair
+    kernels off-TPU, for structure tests). Differentiable on every path.
     """
     if impl not in ("auto", "dense", "flash"):
         raise ValueError(f"impl must be auto|dense|flash, got {impl!r}")
     if impl == "auto":
+        from elephas_tpu.ops.attention import pallas_min_seq
+
         use_flash = (
-            jax.default_backend() == "tpu" and q.shape[2] >= _PALLAS_MIN_SHARD
+            jax.default_backend() == "tpu"
+            and q.shape[2] >= pallas_min_seq(q.shape[3])
         )
     else:
         use_flash = impl == "flash"

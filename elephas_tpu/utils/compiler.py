@@ -56,3 +56,73 @@ def tpu_compiler_options() -> Optional[dict]:
     if value <= 0:
         return None
     return {"xla_tpu_scoped_vmem_limit_kib": str(value)}
+
+
+# The measured-separable candidate (see module docstring's A/B table):
+# +4–5% on conv-heavy steps, −43% on the scan-heavy LSTM — exactly why a
+# MEASUREMENT per workload, not a default, must pick it.
+_SCOPED_VMEM_CANDIDATE_KIB = 98304
+
+
+def autotune_candidates():
+    """``[(label, compiler_options)]`` worth A/B-ing for a hot program.
+
+    One entry (nothing to tune) off-TPU or when the user already forced
+    an option set via ``$ELEPHAS_SCOPED_VMEM_KIB`` — an explicit choice
+    always wins over the autotuner."""
+    base = tpu_compiler_options()
+    if jax.default_backend() != "tpu" or base is not None:
+        return [("default", base)]
+    return [
+        ("default", None),
+        (
+            "scoped_vmem_96m",
+            {"xla_tpu_scoped_vmem_limit_kib": str(_SCOPED_VMEM_CANDIDATE_KIB)},
+        ),
+    ]
+
+
+def autotune_compile_options(build, run, force, steps: int = 24, candidates=None):
+    """One-shot per-workload compile-option A/B (VERDICT r4 #5).
+
+    ``build(opts) -> fn`` compiles the workload's hot program with one
+    candidate's options; ``run(fn) -> out`` DISPATCHES it once
+    (no blocking); ``force(out)`` makes its result real (fetch a
+    scalar — on the tunneled dev chip ``block_until_ready`` lies).
+    Each candidate is compiled, warmed with one forced run, then timed
+    over ``steps`` dispatches with ONE trailing force — a force per
+    step would bill a host↔device round-trip (~50–90ms through the dev
+    tunnel) to every step and drown the per-step signal the A/B exists
+    to read. The fastest candidate wins.
+
+    Returns ``(winner_label, winner_options, ms_per_step_table)``.
+    With a single candidate (off-TPU / env-forced) nothing is timed —
+    the only candidate is returned with an empty table, so callers can
+    gate unconditionally on ``autotune=True``.
+    """
+    import time
+
+    if candidates is None:
+        candidates = autotune_candidates()
+    if len(candidates) == 1:
+        label, opts = candidates[0]
+        return label, opts, {}
+    table = {}
+    by_label = {}
+    for label, opts in candidates:
+        fn = build(opts)
+        force(run(fn))  # compile + warm
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(steps):
+            out = run(fn)
+        force(out)
+        table[label] = (time.perf_counter() - t0) / steps * 1e3
+        by_label[label] = opts
+    winner = min(table, key=table.get)
+    logger.info(
+        "compile autotune: %r wins — %s",
+        winner,
+        {k: f"{v:.2f}ms" for k, v in table.items()},
+    )
+    return winner, by_label[winner], table

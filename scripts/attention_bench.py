@@ -29,7 +29,7 @@ def build(seq: int, impl: str, heads: int = 8, dim: int = 64, batch: int = 1):
 
     def loss_fn(q, k, v):
         # 'pallas'/'xla_custom_vjp' force their kernel through the SHIPPED
-        # custom-VJP path regardless of the public API's _PALLAS_MIN_SEQ
+        # custom-VJP path regardless of the public API's pallas_min_seq
         # dispatch (this script MEASURES the crossover that dispatch
         # encodes, so both arms must be what production actually runs);
         # 'xla_autodiff' is the plain-autodiff lower bound for context.
@@ -107,7 +107,12 @@ def measure(fn, args, steps: int, warmup: int = 3) -> float:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", type=int, nargs="*", default=[2048, 4096, 8192])
+    ap.add_argument("--dims", type=int, nargs="*", default=[64],
+                    help="head_dims to sweep (the crossover is "
+                         "shape-dependent — ops.attention.pallas_min_seq)")
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--impls", nargs="*",
+                    default=["xla_autodiff", "xla_custom_vjp", "pallas"])
     ap.add_argument("--ring", action="store_true",
                     help="bench the ring arms (dense-hop vs flash-hop) "
                          "at --seqs tokens/shard instead of the "
@@ -115,8 +120,8 @@ def main():
     args = ap.parse_args()
 
     print(f"devices={jax.devices()}", file=sys.stderr)
-    by_seq = {}
     if args.ring:
+        by_seq = {}
         for seq in args.seqs:
             for impl in ("dense", "flash"):
                 fn, data = build_ring(seq, impl)
@@ -135,23 +140,27 @@ def main():
                 ),
             }), flush=True)
         return
-    for seq in args.seqs:
-        for impl in ("xla_autodiff", "xla_custom_vjp", "pallas"):
-            fn, data = build(seq, impl)
-            sec = measure(fn, data, args.steps)
-            by_seq.setdefault(seq, {})[impl] = sec
-            print(json.dumps({
-                "seq": seq, "impl": impl, "fwd_bwd_ms": round(sec * 1e3, 2),
-            }), flush=True)
-            del fn, data
-    for seq, r in by_seq.items():
-        # The threshold decision compares the two SHIPPED paths.
-        print(json.dumps({
-            "seq": seq,
-            "speedup_pallas_vs_xla_custom_vjp": round(
-                r["xla_custom_vjp"] / r["pallas"], 2
-            ),
-        }), flush=True)
+    for dim in args.dims:
+        by_seq = {}
+        for seq in args.seqs:
+            for impl in args.impls:
+                fn, data = build(seq, impl, dim=dim)
+                sec = measure(fn, data, args.steps)
+                by_seq.setdefault(seq, {})[impl] = sec
+                print(json.dumps({
+                    "seq": seq, "head_dim": dim, "impl": impl,
+                    "fwd_bwd_ms": round(sec * 1e3, 2),
+                }), flush=True)
+                del fn, data
+        for seq, r in by_seq.items():
+            # The threshold decision compares the two SHIPPED paths.
+            if "xla_custom_vjp" in r and "pallas" in r:
+                print(json.dumps({
+                    "seq": seq, "head_dim": dim,
+                    "speedup_pallas_vs_xla_custom_vjp": round(
+                        r["xla_custom_vjp"] / r["pallas"], 2
+                    ),
+                }), flush=True)
 
 
 if __name__ == "__main__":

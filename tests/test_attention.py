@@ -59,6 +59,34 @@ def test_flash_attention_grad_matches_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_pallas_dispatch_is_shape_aware():
+    """The Pallas/XLA crossover is a function of (seq, head_dim), not a
+    module constant (VERDICT r4 #7): measured dims keep the 2048
+    threshold, unmeasured larger dims get the conservative 4096, and
+    the dispatch predicate honors both axes."""
+    import unittest.mock as mock
+
+    import jax.numpy as jnp
+
+    from elephas_tpu.ops import attention as attn
+
+    assert attn.pallas_min_seq(32) == 2048
+    assert attn.pallas_min_seq(64) == 2048
+    assert attn.pallas_min_seq(128) == 2048
+    assert attn.pallas_min_seq(256) == 4096  # unmeasured: conservative
+
+    def q(seq, dim):
+        return jnp.zeros((1, 2, seq, dim), dtype=jnp.bfloat16)
+
+    with mock.patch.object(attn, "_on_tpu", lambda: True):
+        assert attn._use_pallas(q(2048, 64))
+        assert attn._use_pallas(q(2048, 128))
+        assert not attn._use_pallas(q(1024, 64))
+        assert not attn._use_pallas(q(2048, 256))  # big dim: not until 4096
+        assert attn._use_pallas(q(4096, 256))
+    assert not attn._use_pallas(q(8192, 64))  # never off-TPU
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_dense(devices, causal):
     """Exact attention across a 4-way sequence-sharded ring."""

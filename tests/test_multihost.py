@@ -56,6 +56,7 @@ net = compile_model(
 model = SparkModel(
     net, mode=mode, frequency="epoch",
     parameter_server_mode=psmode, num_workers=8, port=port,
+    autotune=bool(int(os.environ.get("ELEPHAS_TEST_AUTOTUNE", "0"))),
 )
 epochs = int(os.environ.get("ELEPHAS_TEST_EPOCHS", "3"))
 stream = int(os.environ.get("ELEPHAS_TEST_STREAM", "0")) or None
@@ -74,6 +75,7 @@ print("RESULT " + __import__("json").dumps(
     {"proc": idx, "acc": history["acc"][-1], "digest": digest,
      "pred_digest": pred_digest, "pred_shape": list(np.asarray(preds).shape),
      "eval": {k: float(v) for k, v in sorted(ev.items())},
+     "autotune": history.get("compile_autotune"),
      "val_acc": history["val_acc"], "val_loss": history["val_loss"]}
 ))
 """
@@ -97,6 +99,23 @@ def _free_port() -> int:
     ],
 )
 def test_two_process_training_all_modes(tmp_path, mode, ps_mode, stream):
+    _run_two_process_matrix(tmp_path, mode, ps_mode, stream, autotune=False)
+
+
+@pytest.mark.parametrize(
+    "mode,ps_mode,stream", [("synchronous", "http", 0), ("hogwild", "http", 0)],
+)
+def test_two_process_autotune_decision_is_job_wide(tmp_path, mode, ps_mode, stream):
+    """autotune=True across REAL process boundaries: the decision
+    broadcast (engine.sync.decide_autotune, a collective) must complete
+    on every rank and leave the IDENTICAL recorded choice — sync runs
+    the lockstep SPMD A/B, async/hogwild the local-device one. On the
+    CPU test backend the candidate list is singular, so this pins the
+    collective/consistency plumbing, not a timing delta."""
+    _run_two_process_matrix(tmp_path, mode, ps_mode, stream, autotune=True)
+
+
+def _run_two_process_matrix(tmp_path, mode, ps_mode, stream, autotune):
     """All three coordination modes across REAL process boundaries
     (VERDICT r2 #4): async/hogwild share one PS on host 0; synchronous is
     pure SPMD over the global 8-way mesh (also exercised with
@@ -114,6 +133,10 @@ def test_two_process_training_all_modes(tmp_path, mode, ps_mode, stream):
         env["ELEPHAS_TEST_STREAM"] = str(stream)
     else:
         env.pop("ELEPHAS_TEST_STREAM", None)
+    if autotune:
+        env["ELEPHAS_TEST_AUTOTUNE"] = "1"
+    else:
+        env.pop("ELEPHAS_TEST_AUTOTUNE", None)
     env["ELEPHAS_PS_BIND"] = "127.0.0.1"  # same-machine "hosts" in CI
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
@@ -153,6 +176,11 @@ def test_two_process_training_all_modes(tmp_path, mode, ps_mode, stream):
     assert len(results[0]["val_acc"]) == 3
     assert results[0]["val_acc"] == results[1]["val_acc"]
     assert results[0]["val_loss"] == results[1]["val_loss"]
+    if autotune:
+        # The job-wide decision: identical recorded choice on every rank.
+        assert results[0]["autotune"] == results[1]["autotune"] == "default"
+    else:
+        assert results[0]["autotune"] is None
 
 
 _SPTP_CHILD = """

@@ -192,6 +192,80 @@ def test_async_stale_fire_surfaced_in_history(data, caplog):
     assert history2["val_stale"] == [0.0, 0.0, 0.0]
 
 
+def test_autotune_helper_picks_the_faster_candidate():
+    """The one-shot A/B (VERDICT r4 #5) times each candidate's program
+    and returns the faster — candidate injection keeps the test
+    backend-independent (on CPU the real candidate list is singular)."""
+    import time as _time
+
+    from elephas_tpu.utils.compiler import (
+        autotune_candidates, autotune_compile_options,
+    )
+
+    forced = []
+
+    def build(opts):
+        delay = 0.004 if opts == {"slow": "1"} else 0.0
+        def fn():
+            _time.sleep(delay)
+            return opts
+        return fn
+
+    winner, opts, table = autotune_compile_options(
+        build, lambda fn: fn(), forced.append, steps=3,
+        candidates=[("slow", {"slow": "1"}), ("fast", {"fast": "1"})],
+    )
+    assert winner == "fast" and opts == {"fast": "1"}
+    assert set(table) == {"slow", "fast"} and table["fast"] < table["slow"]
+    # One warm force + one trailing force per candidate — never per step
+    # (a per-step force would bill a tunnel RTT to every step).
+    assert len(forced) == 4
+    # Off-TPU the real candidate list is singular: nothing to time.
+    assert len(autotune_candidates()) == 1
+    w, o, t = autotune_compile_options(build, lambda fn: fn(), forced.append)
+    assert w == "default" and t == {}
+
+
+@pytest.mark.parametrize("mode", ["synchronous", "hogwild"])
+def test_autotune_fit_records_choice(data, mode):
+    """autotune=True trains normally and records the choice in history
+    (on the CPU test backend the candidate list is singular, so the
+    A/B is a recorded no-op — the TPU delta lives in PARITY.md)."""
+    x, y = data
+    model = SparkModel(
+        fresh_model(), mode=mode, frequency="epoch", num_workers=2,
+        autotune=True,
+    )
+    history = model.fit(
+        to_simple_rdd(None, x, y, 2), epochs=2, batch_size=16,
+    )
+    assert history["compile_autotune"] == "default"
+    assert model.last_autotune == {"winner": "default", "ms_per_2batch": {}}
+    assert history["acc"][-1] > 0.8
+
+
+def test_autotune_skip_paths_are_visible(data):
+    """Paths that cannot honor the A/B (frequency='fit' parity mode,
+    streamed fits) must RECORD the skip instead of silently keeping
+    defaults while claiming a winner."""
+    x, y = data
+    rdd = to_simple_rdd(None, x, y, 2)
+
+    parity = SparkModel(
+        fresh_model(), mode="synchronous", frequency="fit", num_workers=2,
+        autotune=True,
+    )
+    hist = parity.fit(rdd, epochs=2, batch_size=16)
+    assert hist["compile_autotune"] == "skipped"
+
+    streamed = SparkModel(
+        fresh_model(), mode="synchronous", frequency="epoch", num_workers=2,
+        autotune=True,
+    )
+    hist2 = streamed.fit(rdd, epochs=2, batch_size=16, stream_batches=2)
+    assert hist2["compile_autotune"] == "skipped"
+
+
 def test_second_evaluate_hits_jit_cache(data):
     # VERDICT r1 weak#1: evaluate/predict must reuse the trainer's jit
     # cache instead of re-wrapping (and retracing) per call.
