@@ -159,8 +159,17 @@ class AsyncTrainer:
         from elephas_tpu.engine.sync import _AUTOTUNE_SKIPPED, decide_autotune
         from elephas_tpu.utils.compiler import autotune_compile_options
 
+        multi_host = jax.process_count() > 1
+        if multi_host:
+            from elephas_tpu.parallel import distributed
+
         local = None
-        if self.workers:
+        # Unlike the sync A/B (a global SPMD program every rank must run
+        # in lockstep), this one is LOCAL to one device — and host 0's
+        # table decides for the job, so timing it anywhere else would be
+        # two discarded compiles + 50 dispatches per rank per fit.
+        times_here = not multi_host or distributed.is_host0()
+        if times_here and self.workers:
             g, device = self.workers[0]
             x, y = dataset.partition(g)
             nb = min(2, len(x) // batch_size)
@@ -194,7 +203,7 @@ class AsyncTrainer:
                     # axon: block_until_ready lies — force a scalar
                     lambda out: float(out[1]["loss"]),
                 )
-        decided = decide_autotune(local, jax.process_count() > 1)
+        decided = decide_autotune(local, multi_host)
         if decided is None:
             # Nowhere (that matters) could time: visible, not silent.
             self.autotune_choice = dict(_AUTOTUNE_SKIPPED)

@@ -21,6 +21,7 @@ import numpy as np
 from elephas_tpu.api.spark_model import SparkModel
 from elephas_tpu.data.dataframe import DataFrame, df_to_simple_rdd
 from elephas_tpu.ml.params import (
+    HasAutotune,
     HasBatchSize,
     HasCategoricalLabels,
     HasEpochs,
@@ -44,6 +45,7 @@ from elephas_tpu.serialize.serialization import dict_to_model, model_to_dict
 
 class ElephasEstimator(
     HasKerasModelConfig,
+    HasAutotune,
     HasMode,
     HasFrequency,
     HasNumberOfClasses,
@@ -131,6 +133,7 @@ class ElephasEstimator(
             parameter_server_mode=self.parameter_server_mode,
             num_workers=self.num_workers,
             batch_size=self.batch_size,
+            autotune=self.autotune,
         )
         spark_model.fit(
             rdd,

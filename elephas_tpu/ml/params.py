@@ -125,3 +125,9 @@ HasLabelCol = _mixin("label_col", "label column name", "label")
 HasParameterServerMode = _mixin(
     "parameter_server_mode", "async weight transport: local|http|socket", "local"
 )
+HasAutotune = _mixin(
+    "autotune",
+    "one-shot per-workload compile-option A/B at fit start "
+    "(utils/compiler.py; choice lands in history as compile_autotune)",
+    False,
+)

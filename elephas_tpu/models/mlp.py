@@ -48,10 +48,17 @@ class MaskedMLP(nn.Module):
 
     Exactness: padded units' activations are multiplied by a 0/1 mask,
     so they contribute nothing forward and receive zero gradient —
-    parameters, optimizer moments, and the loss trajectory behave as a
-    true ``active``-width network (the padded columns just ride along
-    at their init values). The compute cost is the bucket's, the
-    statistics are the active width's — the standard padding trade.
+    parameters and optimizer moments behave as an ``active``-width
+    network (the padded columns just ride along at their init values).
+    Initialization is corrected for the masking: a Dense layer fed by a
+    masked layer sees ``bucket`` input dims but only ``active`` of them
+    are live, so its kernel init std is rescaled by
+    ``sqrt(bucket/active)`` to match a true active-width network's
+    fan-in variance — without this, activations shrink as the bucket
+    grows and the loss trajectory would jump discontinuously across
+    bucket boundaries (width 128 vs 129). The compute cost is the
+    bucket's, the statistics are the active width's — the standard
+    padding trade.
     """
 
     features: Sequence[int] = (128,)
@@ -66,7 +73,20 @@ class MaskedMLP(nn.Module):
                 f"active widths {self.active} must match bucket layout "
                 f"{self.features} layer-for-layer"
             )
+
+        def fan_in_corrected(bucket_in: int, live_in: int):
+            # lecun_normal with the LIVE fan-in: the kernel physically
+            # has bucket_in rows, but only live_in carry signal.
+            base = nn.initializers.lecun_normal()
+            scale = (bucket_in / live_in) ** 0.5
+
+            def init(key, shape, dtype=jnp.float32):
+                return base(key, shape, dtype) * scale
+
+            return init
+
         x = x.reshape((x.shape[0], -1))
+        prev_bucket = prev_live = None  # first layer: true input fan-in
         for i, (bucket, live) in enumerate(zip(self.features, self.active)):
             if not 0 < live <= bucket:
                 raise ValueError(
@@ -77,11 +97,22 @@ class MaskedMLP(nn.Module):
                 f"mask_{i}",
                 lambda: (jnp.arange(bucket) < live).astype(jnp.float32),
             )
-            x = nn.Dense(bucket)(x)
+            kernel_init = (
+                fan_in_corrected(prev_bucket, prev_live)
+                if prev_bucket is not None
+                else nn.linear.default_kernel_init
+            )
+            x = nn.Dense(bucket, kernel_init=kernel_init)(x)
             x = nn.relu(x) * mask.value
             if self.dropout_rate > 0:
                 x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        return nn.Dense(self.num_classes)(x)
+            prev_bucket, prev_live = bucket, live
+        kernel_init = (
+            fan_in_corrected(prev_bucket, prev_live)
+            if prev_bucket is not None
+            else nn.linear.default_kernel_init
+        )
+        return nn.Dense(self.num_classes, kernel_init=kernel_init)(x)
 
 
 @register_model("mlp_masked")

@@ -85,10 +85,11 @@ def pallas_min_seq(head_dim: int) -> int:
     crossover therefore sits between 2k and 3k regardless of head_dim
     in [32, 128] — the threshold stays 2048 there (worst case is
     noise-level parity on one marginal shape, and every longer length
-    wins). Head dims beyond the measured range fall back to a
-    conservative 4096 so an unmeasured tiling can't silently regress.
+    wins). Head dims OUTSIDE the measured range — larger than 128 or
+    smaller than 32 — fall back to a conservative 4096 so an unmeasured
+    tiling can't silently regress.
     """
-    return 2048 if head_dim <= 128 else 4096
+    return 2048 if 32 <= head_dim <= 128 else 4096
 
 
 def _on_tpu() -> bool:

@@ -27,7 +27,7 @@ Two per-hop kernels, dispatched by shard length (``impl='auto'``):
 
 Usage: inside ``shard_map`` with q/k/v sharded as P(batch?, 'seq', ...)
 on the sequence dimension (see ``ring_self_attention`` and
-``SeqParallelTrainer`` for the wired-up paths).
+``seq_parallel.make_lm_train_step`` for the wired-up paths).
 """
 
 from __future__ import annotations

@@ -69,10 +69,14 @@ def autotune_candidates():
 
     One entry (nothing to tune) off-TPU or when the user already forced
     an option set via ``$ELEPHAS_SCOPED_VMEM_KIB`` — an explicit choice
-    always wins over the autotuner."""
+    always wins over the autotuner, and is LABELED as such so the
+    recorded ``compile_autotune`` never claims 'default' for a fit that
+    actually compiled with the forced knob."""
+    if jax.default_backend() != "tpu":
+        return [("default", None)]
     base = tpu_compiler_options()
-    if jax.default_backend() != "tpu" or base is not None:
-        return [("default", base)]
+    if base is not None:
+        return [("env_forced", base)]
     return [
         ("default", None),
         (

@@ -74,6 +74,7 @@ def test_pallas_dispatch_is_shape_aware():
     assert attn.pallas_min_seq(64) == 2048
     assert attn.pallas_min_seq(128) == 2048
     assert attn.pallas_min_seq(256) == 4096  # unmeasured: conservative
+    assert attn.pallas_min_seq(16) == 4096  # below the measured range too
 
     def q(seq, dim):
         return jnp.zeros((1, 2, seq, dim), dtype=jnp.bfloat16)

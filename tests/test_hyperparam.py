@@ -172,6 +172,30 @@ def test_masked_mlp_is_exactly_the_active_width():
     np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b))
 
 
+def test_masked_mlp_init_variance_matches_active_width():
+    """Downstream kernels are fan-in-corrected: a (bucket=256, active=64)
+    model's output-layer init variance matches a TRUE 64-wide model's
+    (1/64), not the bucket's (1/256) — otherwise activations shrink with
+    the bucket and the loss trajectory jumps across bucket boundaries."""
+    import jax
+
+    from elephas_tpu.api.compile import CompiledModel
+    from elephas_tpu.models import get_model
+
+    bucketed = CompiledModel(
+        get_model("mlp_masked", features=(256,), active=(64,), num_classes=8),
+        optimizer="sgd", loss="categorical_crossentropy", metrics=[],
+        input_shape=(20,), seed=0,
+    )
+    out_kernel = np.asarray(bucketed.params["Dense_1"]["kernel"])
+    # Live rows only (padded rows never fire; their scale is irrelevant).
+    live_std = out_kernel[:64].std()
+    want = (1.0 / 64) ** 0.5  # lecun_normal at the ACTIVE fan-in
+    assert abs(live_std - want) / want < 0.15  # statistical, seeded
+    # And NOT the uncorrected bucket-scaled std (1/sqrt(256) = want/2).
+    assert live_std > 1.5 * (1.0 / 256) ** 0.5
+
+
 def test_masked_widths_share_one_executable():
     """Two trials in the same bucket — different active widths AND
     different (injected) learning rates — reuse ONE compiled executable:

@@ -127,6 +127,18 @@ def test_regression_transform_single_row(df):
     assert out[transformer.output_col].shape[0] == 1
 
 
+def test_estimator_autotune_param(df):
+    """The stage exposes the reference-style autotune param and plumbs
+    it into SparkModel (no-op A/B on the CPU backend, but the recorded
+    choice proves the wiring)."""
+    est = make_estimator().set_autotune(True)
+    assert est.autotune is True
+    assert "autotune" in est.param_map()
+    transformer = est.fit(df)
+    out = transformer.transform(df)
+    assert out[transformer.output_col].shape[0] == len(df)
+
+
 def test_wrong_kind_load_raises(tmp_path):
     est = make_estimator()
     path = os.path.join(tmp_path, "est.pkl")
