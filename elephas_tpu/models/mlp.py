@@ -85,8 +85,14 @@ class MaskedMLP(nn.Module):
 
             return init
 
+        def pick_init(prev_bucket, prev_live):
+            # First layer (no masked predecessor): true input fan-in.
+            if prev_bucket is None:
+                return nn.linear.default_kernel_init
+            return fan_in_corrected(prev_bucket, prev_live)
+
         x = x.reshape((x.shape[0], -1))
-        prev_bucket = prev_live = None  # first layer: true input fan-in
+        prev_bucket = prev_live = None
         for i, (bucket, live) in enumerate(zip(self.features, self.active)):
             if not 0 < live <= bucket:
                 raise ValueError(
@@ -97,22 +103,14 @@ class MaskedMLP(nn.Module):
                 f"mask_{i}",
                 lambda: (jnp.arange(bucket) < live).astype(jnp.float32),
             )
-            kernel_init = (
-                fan_in_corrected(prev_bucket, prev_live)
-                if prev_bucket is not None
-                else nn.linear.default_kernel_init
-            )
-            x = nn.Dense(bucket, kernel_init=kernel_init)(x)
+            x = nn.Dense(bucket, kernel_init=pick_init(prev_bucket, prev_live))(x)
             x = nn.relu(x) * mask.value
             if self.dropout_rate > 0:
                 x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
             prev_bucket, prev_live = bucket, live
-        kernel_init = (
-            fan_in_corrected(prev_bucket, prev_live)
-            if prev_bucket is not None
-            else nn.linear.default_kernel_init
-        )
-        return nn.Dense(self.num_classes, kernel_init=kernel_init)(x)
+        return nn.Dense(
+            self.num_classes, kernel_init=pick_init(prev_bucket, prev_live)
+        )(x)
 
 
 @register_model("mlp_masked")

@@ -30,6 +30,7 @@ targets for LM training).
 
 from __future__ import annotations
 
+import logging
 from typing import Tuple
 
 import jax
@@ -44,6 +45,8 @@ from elephas_tpu.parallel.mesh import (
     SEQ_AXIS,
     replicated_sharding,
 )
+
+logger = logging.getLogger("elephas_tpu")
 
 
 def make_lm_train_step(compiled, mesh):
@@ -77,27 +80,34 @@ def make_lm_train_step(compiled, mesh):
         )
         return new_state, metrics
 
+    return _lm_shard_map(body, mesh, out_specs=(P(), P()))
+
+
+def _lm_shard_map(body, mesh, out_specs):
+    """Shared jit+shard_map scaffolding for the LM step builders: tokens
+    P('data','seq'), state replicated over the manual axes — and when
+    the mesh composes sp×tp, 'data'/'seq' stay manual while 'model' is
+    delegated to GSPMD (``axis_names``) so the params' tensor-parallel
+    shardings propagate through the body and XLA inserts the model-axis
+    all-reduces. One helper so the TRAIN and EVAL programs can never
+    diverge in their sharding setup."""
     from elephas_tpu.utils.compiler import tpu_compiler_options
 
     token_spec = P(DATA_AXIS, SEQ_AXIS)
     shard_map_kwargs = {}
     if mesh.shape.get(MODEL_AXIS, 1) > 1:
-        # Manual over data/seq only; 'model' stays a GSPMD (auto) axis so
-        # the params' tensor-parallel shardings propagate through the
-        # body and XLA inserts the model-axis all-reduces.
         shard_map_kwargs["axis_names"] = frozenset({DATA_AXIS, SEQ_AXIS})
-    step = jax.jit(
+    return jax.jit(
         jax.shard_map(
             body,
             mesh=mesh,
             in_specs=(P(), token_spec, token_spec),
-            out_specs=(P(), P()),
+            out_specs=out_specs,
             check_vma=False,
             **shard_map_kwargs,
         ),
         compiler_options=tpu_compiler_options(),
     )
-    return step
 
 
 def shard_lm_batch(mesh, tokens: np.ndarray, targets: np.ndarray) -> Tuple:
@@ -119,3 +129,179 @@ def init_lm_state(compiled, mesh, rng=None, rules=None) -> TrainState:
 
         return jax.device_put(state, _state_shardings(mesh, state, rules))
     return jax.device_put(state, replicated_sharding(mesh))
+
+
+def make_lm_eval_step(compiled, mesh):
+    """Deterministic ``eval(state, tokens, targets) -> metrics`` under
+    the same dp×sp(×tp) sharding as the train step: metrics computed on
+    local shards, ``pmean``'d over 'data'×'seq' (exact global means —
+    shard sizes are equal by construction)."""
+    from elephas_tpu.engine.step import make_eval_step
+
+    eval_fn = make_eval_step(compiled)
+
+    def body(state: TrainState, tokens, targets):
+        metrics = eval_fn(state, tokens, targets)
+        return jax.tree_util.tree_map(
+            lambda m: jax.lax.pmean(m, (DATA_AXIS, SEQ_AXIS)), metrics
+        )
+
+    return _lm_shard_map(body, mesh, out_specs=P())
+
+
+class SeqParallelTrainer:
+    """Fit-shaped driver for sequence-parallel LM training — the same
+    ergonomics ``SparkModel.fit`` gives the reference workloads
+    (epochs, shuffling, per-epoch validation, history, callbacks,
+    resume), over the dp×sp(×tp) step builders above.
+
+    The reference has nothing in this regime (SURVEY.md §5.7 — its
+    longest sequence is an IMDB LSTM's few hundred tokens); this is the
+    beyond-parity long-context surface: build a ``TransformerLM`` with
+    ``attention='ring' | 'ulysses' | 'auto'``, pick a mesh
+    (``build_mesh(num_data=D, num_seq=S[, num_model=M])``), and call
+    ``fit`` on a (rows, seq+1) token array. Multi-host: every process
+    calls fit with the SAME arrays (SPMD — shuffles are seeded
+    identically, so every rank sees the same schedule).
+    """
+
+    def __init__(self, compiled, mesh, rules=None):
+        n_data = mesh.shape[DATA_AXIS]
+        n_seq = mesh.shape[SEQ_AXIS]
+        self.compiled = compiled
+        self.mesh = mesh
+        self.rules = rules
+        self.n_data = n_data
+        self.n_seq = n_seq
+        self._train = make_lm_train_step(compiled, mesh)
+        self._eval = None  # compiled lazily: eval-less fits skip the jit
+
+    def _check_seq(self, tokens: np.ndarray) -> None:
+        seq = tokens.shape[1] - 1
+        if seq % self.n_seq != 0:
+            raise ValueError(
+                f"sequence length {seq} (tokens.shape[1]-1) must divide "
+                f"by the seq-axis size {self.n_seq}"
+            )
+
+    def _check_batch(self, tokens: np.ndarray, batch_size: int) -> None:
+        if batch_size % self.n_data != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must divide by the data-axis "
+                f"size {self.n_data} (each data shard takes "
+                "batch_size/num_data rows)"
+            )
+        self._check_seq(tokens)
+
+    def fit(
+        self,
+        tokens: np.ndarray,
+        epochs: int = 1,
+        batch_size: int = 8,
+        validation_tokens=None,
+        val_batch_size: int = None,
+        callbacks=(),
+        initial_state: TrainState = None,
+        rng=None,
+        seed: int = 0,
+        verbose: int = 0,
+    ):
+        """Train on ``tokens`` — (rows, seq+1) int array; position t
+        predicts position t+1 (the shift happens here so shard
+        boundaries stay aligned). Returns ``(state, history)`` with
+        per-epoch ``loss`` (+ ``val_loss`` when ``validation_tokens``
+        is given; ``val_batch_size`` defaults to as much of
+        ``batch_size`` as the validation set allows — a small val set
+        never aborts the fit). ``callbacks``: ``(epoch, state,
+        metrics)`` callables — checkpoint callbacks work unchanged
+        (state is a TrainState). Resuming via ``initial_state``
+        CONTINUES the shuffle schedule from the restored step, so a
+        2+2-epoch resumed fit sees the same batch order as a straight
+        4-epoch one.
+        """
+        tokens = np.asarray(tokens)
+        self._check_batch(tokens, batch_size)
+        state = initial_state if initial_state is not None else init_lm_state(
+            self.compiled, self.mesh, rng=rng, rules=self.rules
+        )
+        nb = len(tokens) // batch_size
+        if nb == 0:
+            raise ValueError(
+                f"{len(tokens)} rows < batch_size {batch_size}"
+            )
+        epoch0 = int(state.step) // nb  # resumed fits continue the schedule
+        history = {"loss": []}
+        for epoch in range(epochs):
+            # Per-epoch stream keyed on the GLOBAL epoch index: identical
+            # on every rank, and stable under resume.
+            perm = np.random.default_rng(
+                [seed, 17, epoch0 + epoch]
+            ).permutation(len(tokens))[: nb * batch_size]
+            device_metrics = []
+            for b in range(nb):
+                rows = tokens[perm[b * batch_size:(b + 1) * batch_size]]
+                x, t = shard_lm_batch(self.mesh, rows[:, :-1], rows[:, 1:])
+                state, metrics = self._train(state, x, t)
+                device_metrics.append(metrics)
+            fetched = jax.device_get(device_metrics)  # ONE fetch per epoch
+            epoch_metrics = {
+                k: float(np.mean([m[k] for m in fetched])) for k in fetched[0]
+            }
+            history["loss"].append(epoch_metrics["loss"])
+            if validation_tokens is not None:
+                val = self.evaluate(
+                    state, validation_tokens, val_batch_size or batch_size
+                )
+                for k, v in val.items():
+                    history.setdefault(f"val_{k}", []).append(v)
+            for cb in callbacks:
+                cb(epoch, state, epoch_metrics)
+            if verbose:
+                print(f"[seq-parallel] epoch {epoch}: "
+                      + ", ".join(f"{k}={v[-1]:.4f}" for k, v in history.items()))
+        return state, history
+
+    def evaluate(self, state, tokens, batch_size: int = 8):
+        """Mean metrics over ``tokens`` ((rows, seq+1)), exact across a
+        ragged final batch (it runs at its own shape — one extra
+        compile — weighted by row count). ``batch_size`` is clamped to
+        the set's size; only rows beyond the last data-axis multiple
+        are dropped (with a warning), since a partial batch must still
+        shard over 'data'."""
+        tokens = np.asarray(tokens)
+        self._check_seq(tokens)
+        usable = (len(tokens) // self.n_data) * self.n_data
+        if usable == 0:
+            raise ValueError(
+                f"{len(tokens)} rows cannot shard over the {self.n_data}-way "
+                "data axis"
+            )
+        if usable < len(tokens):
+            logger.warning(
+                "evaluate: dropping %d of %d rows (not a multiple of the "
+                "%d-way data axis)", len(tokens) - usable, len(tokens),
+                self.n_data,
+            )
+        batch_size = min(batch_size, usable)
+        batch_size -= batch_size % self.n_data
+        self._check_batch(tokens, batch_size)
+        if self._eval is None:
+            self._eval = make_lm_eval_step(self.compiled, self.mesh)
+        device_metrics = []
+        weights = []
+        start = 0
+        while start < usable:
+            stop = min(start + batch_size, usable)
+            if (stop - start) % self.n_data:  # ragged tail: trim to shardable
+                stop = start + ((stop - start) // self.n_data) * self.n_data
+            rows = tokens[start:stop]
+            x, t = shard_lm_batch(self.mesh, rows[:, :-1], rows[:, 1:])
+            device_metrics.append(self._eval(state, x, t))
+            weights.append(stop - start)
+            start = stop
+        fetched = jax.device_get(device_metrics)
+        total = float(sum(weights))
+        return {
+            k: float(sum(m[k] * w for m, w in zip(fetched, weights)) / total)
+            for k in fetched[0]
+        }

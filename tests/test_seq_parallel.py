@@ -192,6 +192,125 @@ def test_unknown_attention_rejected_at_build():
         get_model("transformer_lm", attention="ulyses")  # typo must fail loudly
 
 
+def test_seq_parallel_trainer_fit_history_and_eval(devices):
+    """The fit-shaped long-context driver: shuffled epochs, per-epoch
+    history, validation, callbacks — SparkModel.fit ergonomics over the
+    dp×sp step (the surface the builder-level API lacked)."""
+    from elephas_tpu.parallel.seq_parallel import SeqParallelTrainer
+
+    mesh = build_mesh(num_data=2, num_seq=4)
+    compiled = _compiled("auto", num_heads=4)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, VOCAB, size=(32, SEQ + 1), dtype=np.int32)
+    val = rng.integers(0, VOCAB, size=(8, SEQ + 1), dtype=np.int32)
+
+    seen = []
+    trainer = SeqParallelTrainer(compiled, mesh)
+    state, history = trainer.fit(
+        tokens, epochs=4, batch_size=8, validation_tokens=val,
+        callbacks=[lambda e, s, m: seen.append((e, float(m["loss"])))],
+    )
+    assert len(history["loss"]) == 4
+    assert len(history["val_loss"]) == 4
+    assert history["loss"][-1] < history["loss"][0]  # memorizes the set
+    assert [e for e, _ in seen] == [0, 1, 2, 3]
+    assert int(state.step) == 4 * (32 // 8)
+    # evaluate() agrees with the val history's last entry.
+    ev = trainer.evaluate(state, val, batch_size=8)
+    np.testing.assert_allclose(ev["loss"], history["val_loss"][-1], rtol=1e-5)
+
+
+def test_seq_parallel_trainer_resume_and_sptp(devices):
+    """Resume from a returned state (step keeps counting) — on the
+    COMPOSED 2×2×2 sp×tp mesh, params staying model-sharded through
+    fit/eval."""
+    from elephas_tpu.parallel.seq_parallel import SeqParallelTrainer
+
+    mesh = build_mesh(num_data=2, num_seq=2, num_model=2)
+    seq = 16
+    compiled = CompiledModel(
+        get_model("transformer_lm", vocab_size=VOCAB, d_model=16, num_heads=2,
+                  num_layers=1, max_seq_len=seq, attention="ring"),
+        optimizer={"name": "adam", "learning_rate": 1e-2},
+        loss="sparse_categorical_crossentropy",
+        metrics=[], input_shape=(seq,), input_dtype=jnp.int32, seed=0,
+    )
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, VOCAB, size=(16, seq + 1), dtype=np.int32)
+    trainer = SeqParallelTrainer(compiled, mesh)
+    state, h1 = trainer.fit(tokens, epochs=2, batch_size=8)
+    assert int(state.step) == 4
+    qkv = state.params["Block_0"]["SelfAttention_0"]["qkv"]["kernel"]
+    assert qkv.sharding.shard_shape(qkv.shape)[2] == qkv.shape[2] // 2
+    state2, h2 = trainer.fit(tokens, epochs=2, batch_size=8,
+                             initial_state=state)
+    assert int(state2.step) == 8
+    assert h2["loss"][-1] < h1["loss"][0]
+
+
+def test_seq_parallel_trainer_resume_continues_shuffle_schedule(devices):
+    """A 2+2-epoch resumed fit must follow the SAME batch order as a
+    straight 4-epoch fit (the shuffle stream is keyed on the global
+    epoch from the restored step, not restarted at 0) — bitwise-equal
+    final parameters prove it."""
+    from elephas_tpu.parallel.seq_parallel import SeqParallelTrainer
+
+    mesh = build_mesh(num_data=2, num_seq=4)
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, VOCAB, size=(32, SEQ + 1), dtype=np.int32)
+
+    t1 = SeqParallelTrainer(_compiled("ring"), mesh)
+    straight, _ = t1.fit(tokens, epochs=4, batch_size=8, seed=3)
+
+    t2 = SeqParallelTrainer(_compiled("ring"), mesh)
+    mid, _ = t2.fit(tokens, epochs=2, batch_size=8, seed=3)
+    resumed, _ = t2.fit(tokens, epochs=2, batch_size=8, seed=3,
+                        initial_state=mid)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(straight.params)),
+        jax.tree_util.tree_leaves(jax.device_get(resumed.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_seq_parallel_trainer_small_and_ragged_validation(devices):
+    """A validation set smaller than batch_size must not abort the fit
+    (val batch clamps down), and a ragged set is evaluated EXACTLY via
+    a weighted final partial batch — matching a one-batch whole-set
+    evaluation to float tolerance."""
+    from elephas_tpu.parallel.seq_parallel import SeqParallelTrainer
+
+    mesh = build_mesh(num_data=2, num_seq=4)
+    rng = np.random.default_rng(9)
+    tokens = rng.integers(0, VOCAB, size=(16, SEQ + 1), dtype=np.int32)
+    small_val = rng.integers(0, VOCAB, size=(4, SEQ + 1), dtype=np.int32)
+
+    trainer = SeqParallelTrainer(_compiled("ring"), mesh)
+    state, history = trainer.fit(
+        tokens, epochs=1, batch_size=8, validation_tokens=small_val
+    )
+    assert len(history["val_loss"]) == 1  # 4-row val under batch_size 8: fine
+
+    ragged = rng.integers(0, VOCAB, size=(10, SEQ + 1), dtype=np.int32)
+    chunked = trainer.evaluate(state, ragged, batch_size=8)  # 8 + 2 rows
+    whole = trainer.evaluate(state, ragged, batch_size=10)  # one batch
+    np.testing.assert_allclose(chunked["loss"], whole["loss"], rtol=1e-5)
+
+
+def test_seq_parallel_trainer_validates_divisibility(devices):
+    from elephas_tpu.parallel.seq_parallel import SeqParallelTrainer
+    import pytest
+
+    mesh = build_mesh(num_data=2, num_seq=4)
+    compiled = _compiled("ring")
+    trainer = SeqParallelTrainer(compiled, mesh)
+    tokens = np.zeros((8, SEQ + 1), dtype=np.int32)
+    with pytest.raises(ValueError, match="divide by the data-axis"):
+        trainer.fit(tokens, batch_size=3)
+    with pytest.raises(ValueError, match="divide"):
+        trainer.fit(np.zeros((8, 31), dtype=np.int32), batch_size=2)
+
+
 def test_seq_parallel_matches_single_device_loss(devices):
     """First-step loss under dp x sp must equal the unsharded dense loss."""
     mesh = build_mesh(num_data=2, num_seq=4)
