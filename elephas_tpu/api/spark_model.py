@@ -197,9 +197,13 @@ class TpuModel:
     ) -> Dict[str, List[float]]:
         """Train on a ShardedDataset (or ``(x, y)``), reference §3.1/§3.2.
 
-        ``stream_batches`` (sync mode): cap HBM residency at ~2×N global
-        batches with a double-buffered host→device pipeline — for
-        datasets larger than device memory.
+        ``stream_batches``: cap HBM data residency at ~2×N batches with
+        a double-buffered host→device pipeline — for datasets larger
+        than device memory. Sync mode streams N GLOBAL batches through
+        the SPMD epoch; async/hogwild stream N batches per WORKER
+        through its Downpour loop (a host-side shuffle + partition
+        re-upload per epoch — prefer the default resident path when the
+        partition fits).
 
         ``initial_state``: a restored ``TrainState`` (e.g. from
         ``elephas_tpu.checkpoint.CheckpointManager.restore``) to resume
@@ -258,14 +262,6 @@ class TpuModel:
             )
             self._sync_trainer = trainer
         else:
-            if stream_batches is not None:
-                raise ValueError(
-                    "stream_batches applies to mode='synchronous'; async/"
-                    "hogwild workers hold their partition device-resident "
-                    "(uploaded once, shuffled on device) — for datasets "
-                    "beyond per-chip HBM use mode='synchronous' with "
-                    "stream_batches, or more workers/partitions"
-                )
             from elephas_tpu.engine.async_engine import AsyncTrainer
 
             trainer = AsyncTrainer(
@@ -280,6 +276,7 @@ class TpuModel:
                 ),
                 max_failures=self.max_failures,
                 autotune=self.autotune,
+                stream_batches=stream_batches,
             )
             state, history = trainer.fit(
                 dataset,

@@ -64,10 +64,18 @@ class AsyncTrainer:
         granularity: str = "tree",
         max_failures: int = 4,
         autotune: bool = False,
+        stream_batches: Optional[int] = None,
     ):
         """``granularity`` ('tree'|'leaf'): hogwild apply isolation —
         'leaf' drops at most racing leaves instead of whole deltas at the
         cost of one dispatch per leaf per push (ParameterBuffer note).
+
+        ``stream_batches``: cap each worker's HBM data residency at
+        ~2×N batches with a double-buffered chunk pipeline instead of
+        holding the whole partition device-resident — for partitions
+        beyond per-chip HBM (the async analogue of the sync trainer's
+        streaming). Costs a host-side shuffle + partition re-upload per
+        epoch, so leave unset when the partition fits.
 
         ``autotune``: one-shot per-workload compile-option A/B at fit
         start (VERDICT r4 #5): the scoped-VMEM knob is workload-
@@ -100,6 +108,9 @@ class AsyncTrainer:
         self.port = port
         self.granularity = granularity
         self.max_failures = max_failures
+        if stream_batches is not None and stream_batches < 1:
+            raise ValueError(f"stream_batches must be >= 1, got {stream_batches}")
+        self.stream_batches = stream_batches
         # Phase profiling (scripts/flagship_phases.py): when True, the
         # 'epoch'-frequency worker loop and the epoch fire force device
         # results at phase boundaries and append per-phase wall seconds
@@ -818,36 +829,6 @@ class AsyncTrainer:
             }
             client.update_parameters(delta)
 
-        # The partition is uploaded to the worker's chip ONCE and shuffled
-        # ON DEVICE each epoch (mirroring the sync trainer's in-program
-        # shuffle). The previous host-side gather + per-epoch re-upload
-        # cost a full partition transfer per epoch — tens of seconds per
-        # epoch for CIFAR-sized partitions on a remote-tunneled chip,
-        # dwarfing the epoch's compute. HBM residency: 1× the partition,
-        # plus a second shuffled copy in 'epoch' frequency only (the scan
-        # needs the batched stack); 'batch' frequency gathers one batch
-        # at a time from the resident flat arrays.
-        x_d = jax.device_put(x, device)
-        y_d = jax.device_put(y, device)
-
-        def reshuffle(key, xf, yf):
-            perm = jax.random.permutation(key, xf.shape[0])
-            return (
-                xf[perm].reshape(nb, batch_size, *xf.shape[1:]),
-                yf[perm].reshape(nb, batch_size, *yf.shape[1:]),
-            )
-
-        reshuffle_fn = jax.jit(reshuffle)
-
-        def take_batch(xf, yf, perm, start):
-            idx = jax.lax.dynamic_slice_in_dim(perm, start, batch_size)
-            return jnp.take(xf, idx, axis=0), jnp.take(yf, idx, axis=0)
-
-        take_batch_fn = jax.jit(take_batch)  # start is traced: one compile
-        shuffle_base = jax.random.fold_in(
-            jax.random.fold_in(jax.random.PRNGKey(1234), index), 7
-        )
-
         def run_unit(unit):
             """Spark's ``spark.task.maxFailures`` analogue (SURVEY.md §5.3):
             ``unit(attempt)`` runs one frequency-unit from a fresh PS pull;
@@ -885,6 +866,161 @@ class AsyncTrainer:
                     if attempt + 1 >= self.max_failures:
                         raise
                     epoch_retries += 1
+
+        epoch_retries = 0
+
+        # Per-epoch bookkeeping + worker exit, SHARED by the streamed and
+        # resident paths below — the contract (retry counts, history
+        # shape, barrier callback, client close) must never diverge
+        # between them.
+        def finish_epoch(entry: Dict[str, float], epoch: int) -> None:
+            entry["_retries"] = float(epoch_retries)
+            epoch_metrics.append(entry)
+            if on_epoch_done is not None:
+                on_epoch_done(epoch)
+
+        def finish_worker() -> List[Dict[str, float]]:
+            if hasattr(client, "close"):
+                client.close()
+            return epoch_metrics
+
+        if self.stream_batches is not None:
+            # Streamed partition (opt-in, ``stream_batches=N``): HBM
+            # holds at most ~2×N batches (the training chunk + the next
+            # one uploading behind it) instead of the whole partition —
+            # for partitions beyond per-chip HBM, the async analogue of
+            # the sync trainer's double-buffered pipeline. The price is
+            # a host-side shuffle gather + full-partition re-upload per
+            # epoch; prefer the resident path when the partition fits.
+            chunk_nb = max(1, min(self.stream_batches, nb))
+            chunk_rows = chunk_nb * batch_size
+
+            spans = []
+            start = 0
+            while start < usable:
+                rows_count = min(chunk_rows, usable - start)
+                spans.append((start, rows_count))
+                start += rows_count
+
+            def make_perm(epoch: int, attempt: int) -> np.ndarray:
+                seq = [1234, index, 7, epoch]
+                if attempt:  # re-seeded order clears data-order faults
+                    seq.append(10_000 + attempt)
+                return np.random.default_rng(seq).permutation(usable)
+
+            def upload(perm, start_row, rows_count):
+                sel = perm[start_row:start_row + rows_count]
+                cnb = rows_count // batch_size
+                cx = np.ascontiguousarray(x[sel]).reshape(
+                    cnb, batch_size, *x.shape[1:]
+                )
+                cy = np.ascontiguousarray(y[sel]).reshape(
+                    cnb, batch_size, *y.shape[1:]
+                )
+                return jax.device_put(cx, device), jax.device_put(cy, device)
+
+            global_step = 0
+            for epoch in range(epochs):
+                epoch_retries = 0
+                if self.frequency == "epoch":
+
+                    def epoch_unit(attempt, epoch=epoch):
+                        nonlocal opt_state
+                        perm = make_perm(epoch, attempt)
+                        state0 = pull_state(global_step, attempt)
+                        state = state0
+                        device_metrics, weights = [], []
+                        buf = upload(perm, *spans[0])
+                        for ci in range(len(spans)):
+                            # Dispatch the NEXT chunk's upload before
+                            # scanning this one: host→device transfer
+                            # overlaps the chunk's compute.
+                            nxt = (
+                                upload(perm, *spans[ci + 1])
+                                if ci + 1 < len(spans)
+                                else None
+                            )
+                            state, metrics = self._epoch_fn(state, *buf)
+                            device_metrics.append(metrics)
+                            weights.append(spans[ci][1])
+                            buf = nxt
+                        # Forces every chunk's scan: a device-side fault
+                        # raises HERE (retryable) before the delta is
+                        # pushed (same contract as the resident path).
+                        fetched = jax.device_get(device_metrics)
+                        from elephas_tpu.engine.step import (
+                            weighted_mean_over_chunks,
+                        )
+
+                        out = weighted_mean_over_chunks(
+                            [(0, w, i) for i, w in enumerate(weights)],
+                            lambda start, stop, i: fetched[i],
+                            sum(weights),
+                        )
+                        push_delta(state0, state)
+                        opt_state = state.opt_state
+                        return out
+
+                    entry = run_unit(epoch_unit)
+                    global_step += nb
+                else:  # 'batch': pull/push per step, batches from the chunk
+                    perm = make_perm(epoch, 0)
+                    device_metrics = []
+                    for start_row, rows_count in spans:
+                        cxb, cyb = upload(perm, start_row, rows_count)
+                        for b in range(rows_count // batch_size):
+
+                            def batch_unit(attempt, b=b, cxb=cxb, cyb=cyb):
+                                nonlocal opt_state
+                                state = pull_state(global_step, attempt)
+                                new_state, metrics = self._step_fn(
+                                    state, cxb[b], cyb[b]
+                                )
+                                push_delta(state, new_state)
+                                opt_state = new_state.opt_state
+                                return metrics
+
+                            device_metrics.append(run_unit(batch_unit))
+                            global_step += 1
+                    fetched = jax.device_get(device_metrics)
+                    entry = {
+                        k: float(np.mean([d[k] for d in fetched]))
+                        for k in fetched[0]
+                    }
+                finish_epoch(entry, epoch)
+            return finish_worker()
+
+        # The partition is uploaded to the worker's chip ONCE and shuffled
+        # ON DEVICE each epoch (mirroring the sync trainer's in-program
+        # shuffle). The previous host-side gather + per-epoch re-upload
+        # cost a full partition transfer per epoch — tens of seconds per
+        # epoch for CIFAR-sized partitions on a remote-tunneled chip,
+        # dwarfing the epoch's compute. HBM residency: 1× the partition,
+        # plus a second shuffled copy in 'epoch' frequency only (the scan
+        # needs the batched stack); 'batch' frequency gathers one batch
+        # at a time from the resident flat arrays. Opt-in
+        # ``stream_batches`` (above) trades this for a bounded-HBM
+        # chunk pipeline.
+        x_d = jax.device_put(x, device)
+        y_d = jax.device_put(y, device)
+
+        def reshuffle(key, xf, yf):
+            perm = jax.random.permutation(key, xf.shape[0])
+            return (
+                xf[perm].reshape(nb, batch_size, *xf.shape[1:]),
+                yf[perm].reshape(nb, batch_size, *yf.shape[1:]),
+            )
+
+        reshuffle_fn = jax.jit(reshuffle)
+
+        def take_batch(xf, yf, perm, start):
+            idx = jax.lax.dynamic_slice_in_dim(perm, start, batch_size)
+            return jnp.take(xf, idx, axis=0), jnp.take(yf, idx, axis=0)
+
+        take_batch_fn = jax.jit(take_batch)  # start is traced: one compile
+        shuffle_base = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(1234), index), 7
+        )
 
         global_step = 0
         for epoch in range(epochs):
@@ -945,10 +1081,5 @@ class AsyncTrainer:
                 entry = {
                     k: float(np.mean([d[k] for d in fetched])) for k in fetched[0]
                 }
-            entry["_retries"] = float(epoch_retries)
-            epoch_metrics.append(entry)
-            if on_epoch_done is not None:
-                on_epoch_done(epoch)
-        if hasattr(client, "close"):
-            client.close()
-        return epoch_metrics
+            finish_epoch(entry, epoch)
+        return finish_worker()

@@ -282,26 +282,29 @@ class SeqParallelTrainer:
                 "%d-way data axis)", len(tokens) - usable, len(tokens),
                 self.n_data,
             )
-        batch_size = min(batch_size, usable)
-        batch_size -= batch_size % self.n_data
+        # Clamp to [n_data, usable] on data-axis multiples — rounding
+        # DOWN past n_data would make a zero-row batch and never advance.
+        batch_size = max(
+            self.n_data,
+            min(batch_size, usable) // self.n_data * self.n_data,
+        )
         self._check_batch(tokens, batch_size)
         if self._eval is None:
             self._eval = make_lm_eval_step(self.compiled, self.mesh)
-        device_metrics = []
-        weights = []
+        spans = []
         start = 0
         while start < usable:
             stop = min(start + batch_size, usable)
-            if (stop - start) % self.n_data:  # ragged tail: trim to shardable
-                stop = start + ((stop - start) // self.n_data) * self.n_data
+            spans.append((start, stop, len(spans)))
+            start = stop
+        device_metrics = []
+        for start, stop, _ in spans:
             rows = tokens[start:stop]
             x, t = shard_lm_batch(self.mesh, rows[:, :-1], rows[:, 1:])
             device_metrics.append(self._eval(state, x, t))
-            weights.append(stop - start)
-            start = stop
-        fetched = jax.device_get(device_metrics)
-        total = float(sum(weights))
-        return {
-            k: float(sum(m[k] * w for m, w in zip(fetched, weights)) / total)
-            for k in fetched[0]
-        }
+        fetched = jax.device_get(device_metrics)  # ONE fetch for all chunks
+        from elephas_tpu.engine.step import weighted_mean_over_chunks
+
+        return weighted_mean_over_chunks(
+            spans, lambda start, stop, i: fetched[i], usable
+        )

@@ -242,6 +242,49 @@ def test_transient_batch_fault_retries_at_batch_granularity():
     assert len(history["loss"]) == 2
 
 
+def test_transient_fault_retries_under_streaming():
+    """The retry contract holds on the STREAMED path: a chunk-scan fault
+    re-streams the whole epoch from a fresh PS pull (epoch granularity,
+    re-seeded order) and the fit completes with the retry recorded."""
+    from elephas_tpu.data.rdd import ShardedDataset
+    from elephas_tpu.engine.async_engine import AsyncTrainer
+    from elephas_tpu import compile_model
+    from elephas_tpu.models import get_model
+    from elephas_tpu.parallel.mesh import build_mesh
+
+    x, y = make_blobs(n=256, num_classes=3, dim=8, seed=3)
+    net = compile_model(
+        get_model("mlp", features=(16,), num_classes=3),
+        optimizer={"name": "sgd", "learning_rate": 0.05},
+        loss="categorical_crossentropy", metrics=["acc"],
+        input_shape=(8,), seed=0,
+    )
+    trainer = AsyncTrainer(
+        net, build_mesh(num_data=2), frequency="epoch", max_failures=4,
+        stream_batches=3,
+    )
+    real_epoch_fn = trainer._epoch_fn
+    fails = {"left": 1}
+    gate = threading.Lock()
+
+    def flaky_epoch_fn(state, xb, yb):
+        with gate:
+            inject = fails["left"] > 0
+            if inject:
+                fails["left"] -= 1
+        if inject:
+            raise RuntimeError("injected transient chunk fault")
+        return real_epoch_fn(state, xb, yb)
+
+    trainer._epoch_fn = flaky_epoch_fn
+    state, history = trainer.fit(
+        ShardedDataset(x, y, 2), epochs=3, batch_size=16
+    )
+    assert fails["left"] == 0
+    assert history["worker_retries"] == [1, 0, 0]
+    assert history["acc"][-1] > 0.6
+
+
 def test_hard_worker_fault_fails_after_max_failures():
     """A unit that ALWAYS raises must exhaust exactly ``max_failures``
     attempts and then fail the fit with the original exception."""

@@ -96,6 +96,7 @@ def _free_port() -> int:
         ("synchronous", "http", 4),  # double-buffered streaming sync (r3 #7)
         ("hogwild", "http", 0),
         ("hogwild", "socket", 0),
+        ("hogwild", "http", 3),  # streamed async partitions (r5)
     ],
 )
 def test_two_process_training_all_modes(tmp_path, mode, ps_mode, stream):

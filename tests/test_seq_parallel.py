@@ -295,6 +295,10 @@ def test_seq_parallel_trainer_small_and_ragged_validation(devices):
     chunked = trainer.evaluate(state, ragged, batch_size=8)  # 8 + 2 rows
     whole = trainer.evaluate(state, ragged, batch_size=10)  # one batch
     np.testing.assert_allclose(chunked["loss"], whole["loss"], rtol=1e-5)
+    # batch_size below the data-axis size clamps UP (a round-down to 0
+    # would loop forever) and still evaluates the whole set exactly.
+    tiny_bs = trainer.evaluate(state, ragged, batch_size=1)
+    np.testing.assert_allclose(tiny_bs["loss"], whole["loss"], rtol=1e-5)
 
 
 def test_seq_parallel_trainer_validates_divisibility(devices):

@@ -192,6 +192,32 @@ def test_async_stale_fire_surfaced_in_history(data, caplog):
     assert history2["val_stale"] == [0.0, 0.0, 0.0]
 
 
+@pytest.mark.parametrize(
+    "mode,frequency",
+    [("asynchronous", "epoch"), ("hogwild", "epoch"), ("asynchronous", "batch")],
+)
+def test_async_streamed_partitions_converge(data, mode, frequency):
+    """stream_batches in async/hogwild (the sync streaming analogue):
+    each worker holds ~2×N batches in HBM instead of its whole
+    partition — chunks double-buffer through the Downpour loop, with a
+    ragged final chunk — and training converges with a full per-epoch
+    val history, exactly like the resident path."""
+    x, y = data
+    model = SparkModel(
+        fresh_model(), mode=mode, frequency=frequency, num_workers=2
+    )
+    rdd = to_simple_rdd(None, x, y, num_partitions=2)
+    epochs = 4
+    history = model.fit(
+        rdd, epochs=epochs, batch_size=16, stream_batches=3,
+        validation_split=0.1,
+    )
+    assert history["acc"][-1] > 0.8
+    assert len(history["val_acc"]) == epochs
+    ev = model.evaluate(x, y)
+    assert ev["acc"] > 0.8
+
+
 def test_autotune_helper_picks_the_faster_candidate():
     """The one-shot A/B (VERDICT r4 #5) times each candidate's program
     and returns the faster — candidate injection keeps the test

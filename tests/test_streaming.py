@@ -80,8 +80,29 @@ def test_streaming_rejects_fit_parity_mode():
         model.fit(to_simple_rdd(None, x, y, 4), epochs=1, batch_size=16, stream_batches=2)
 
 
-def test_streaming_rejects_async_mode():
+def test_streaming_async_supported_and_validates():
+    """r5: async/hogwild accept stream_batches (the bounded-HBM worker
+    pipeline — convergence matrix in test_spark_model.py); a nonsense
+    chunk size still fails loudly at construction."""
+    import pytest as _pytest
+
+    from elephas_tpu.engine.async_engine import AsyncTrainer
+    from elephas_tpu.parallel.mesh import build_mesh
+    from elephas_tpu.api.compile import CompiledModel
+    from elephas_tpu.models import get_model
+
+    net = CompiledModel(
+        get_model("mlp", features=(8,), num_classes=NUM_CLASSES),
+        optimizer="sgd", loss="categorical_crossentropy", metrics=[],
+        input_shape=(DIM,),
+    )
+    with _pytest.raises(ValueError, match="stream_batches"):
+        AsyncTrainer(net, build_mesh(num_data=2), stream_batches=0)
+
     x, y = make_blobs(n=256, num_classes=NUM_CLASSES, dim=DIM, seed=8)
     model = SparkModel(fresh_model(), mode="asynchronous", num_workers=4)
-    with pytest.raises(ValueError, match="synchronous"):
-        model.fit(to_simple_rdd(None, x, y, 4), epochs=1, batch_size=16, stream_batches=2)
+    history = model.fit(
+        to_simple_rdd(None, x, y, 4), epochs=2, batch_size=16,
+        stream_batches=2,
+    )
+    assert len(history["loss"]) == 2
