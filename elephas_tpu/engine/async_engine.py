@@ -929,9 +929,22 @@ class AsyncTrainer:
                         perm = make_perm(epoch, attempt)
                         state0 = pull_state(global_step, attempt)
                         state = state0
-                        device_metrics, weights = [], []
+                        device_metrics = []
                         buf = upload(perm, *spans[0])
                         for ci in range(len(spans)):
+                            # BACKPRESSURE: before a third chunk enters
+                            # flight, wait for chunk ci-1's scan (its
+                            # metrics force it) so its buffers free —
+                            # without this the host (whose per-chunk work
+                            # is a numpy gather + async dispatch) runs
+                            # arbitrarily far ahead and peak residency
+                            # approaches the whole partition, the exact
+                            # OOM streaming exists to avoid. Cost: one
+                            # small fetch per chunk.
+                            if ci >= 1:
+                                device_metrics[ci - 1] = jax.device_get(
+                                    device_metrics[ci - 1]
+                                )
                             # Dispatch the NEXT chunk's upload before
                             # scanning this one: host→device transfer
                             # overlaps the chunk's compute.
@@ -942,7 +955,6 @@ class AsyncTrainer:
                             )
                             state, metrics = self._epoch_fn(state, *buf)
                             device_metrics.append(metrics)
-                            weights.append(spans[ci][1])
                             buf = nxt
                         # Forces every chunk's scan: a device-side fault
                         # raises HERE (retryable) before the delta is
@@ -953,9 +965,10 @@ class AsyncTrainer:
                         )
 
                         out = weighted_mean_over_chunks(
-                            [(0, w, i) for i, w in enumerate(weights)],
+                            [(s, s + rows, i)
+                             for i, (s, rows) in enumerate(spans)],
                             lambda start, stop, i: fetched[i],
-                            sum(weights),
+                            usable,
                         )
                         push_delta(state0, state)
                         opt_state = state.opt_state
@@ -966,8 +979,22 @@ class AsyncTrainer:
                 else:  # 'batch': pull/push per step, batches from the chunk
                     perm = make_perm(epoch, 0)
                     device_metrics = []
-                    for start_row, rows_count in spans:
-                        cxb, cyb = upload(perm, start_row, rows_count)
+                    prev_last = None  # previous chunk's final batch metric
+                    buf = upload(perm, *spans[0])
+                    for si, (start_row, rows_count) in enumerate(spans):
+                        cxb, cyb = buf
+                        nxt = None
+                        if si + 1 < len(spans):
+                            # Same bounded pipeline as the epoch path:
+                            # wait for the PREVIOUS chunk's work before a
+                            # third chunk uploads, then prefetch the next
+                            # chunk so its transfer overlaps this chunk's
+                            # batch loop.
+                            if prev_last is not None:
+                                device_metrics[prev_last] = jax.device_get(
+                                    device_metrics[prev_last]
+                                )
+                            nxt = upload(perm, *spans[si + 1])
                         for b in range(rows_count // batch_size):
 
                             def batch_unit(attempt, b=b, cxb=cxb, cyb=cyb):
@@ -982,6 +1009,8 @@ class AsyncTrainer:
 
                             device_metrics.append(run_unit(batch_unit))
                             global_step += 1
+                        prev_last = len(device_metrics) - 1
+                        buf = nxt
                     fetched = jax.device_get(device_metrics)
                     entry = {
                         k: float(np.mean([d[k] for d in fetched]))

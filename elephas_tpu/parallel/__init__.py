@@ -15,3 +15,4 @@ from elephas_tpu.parallel.mesh import (  # noqa: F401
     local_device_count,
     replicated_sharding,
 )
+from elephas_tpu.parallel.seq_parallel import SeqParallelTrainer  # noqa: F401

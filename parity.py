@@ -233,7 +233,11 @@ def cifar10_resnet18_hogwild(quick: bool):
     )
     epochs = 2 if quick else 4
     n_workers = len(jax.devices())
-    model = SparkModel(net, mode="hogwild", frequency="epoch", num_workers=n_workers)
+    # Per-workload compile autotune (VERDICT r4 #5): the flagship fit
+    # picks its own compile options from a 2-batch A/B; the choice is
+    # recorded in the emitted row (``compile_autotune``).
+    model = SparkModel(net, mode="hogwild", frequency="epoch",
+                       num_workers=n_workers, autotune=True)
     timer = EpochTimer()
     t0 = time.perf_counter()
     history = model.fit(
@@ -246,6 +250,7 @@ def cifar10_resnet18_hogwild(quick: bool):
     return _record(
         "cifar10_resnet18_hogwild", "hogwild", history, len(x), epochs, secs, real,
         timer,
+        extra={"compile_autotune": history.get("compile_autotune")},
     )
 
 
