@@ -20,9 +20,12 @@ the TPU rebuild. The attention implementation is pluggable:
 
 from __future__ import annotations
 
+import dataclasses
+import functools
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -44,6 +47,7 @@ class SelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.float32
     attention: str = "dense"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -55,6 +59,8 @@ class SelfAttention(nn.Module):
         q = jnp.transpose(q, (0, 2, 1, 3))
         k = jnp.transpose(k, (0, 2, 1, 3))
         v = jnp.transpose(v, (0, 2, 1, 3))
+        if self.decode:
+            return self._decode_attend(x, q, k, v, d_model)
         attention = self.attention
         if attention == "auto" and not self.is_initializing():
             # Resolved at trace time (axis size is static): sequence-
@@ -103,19 +109,78 @@ class SelfAttention(nn.Module):
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(x.shape[0], x.shape[1], d_model)
         return nn.DenseGeneral(d_model, dtype=self.dtype, name="out")(out)
 
+    def _decode_attend(self, x, q, k, v, d_model):
+        """Incremental (KV-cache) attention for autoregressive sampling.
+
+        The cache is SHAPED on the init pass (which feeds a full-length
+        dummy, flax's standard decode protocol) and FILLED by applies:
+        the current block's k/v land at ``cache_index`` (seq may be >1 —
+        batched PREFILL fills the whole prompt in one forward — or 1 per
+        sampling step), and each query attends over everything cached up
+        to its own position. Training never touches this path — it
+        exists for ``generate`` (below)."""
+        b, h, seq, head_dim = q.shape
+        init_pass = not self.has_variable("cache", "cached_key")
+        cached_key = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((b, h, seq, head_dim), self.dtype),
+        )
+        cached_value = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((b, h, seq, head_dim), self.dtype),
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.array(0, jnp.int32)
+        )
+        if init_pass:
+            # Shaping pass only: ordinary causal attention; caches start
+            # zeroed at the full length.
+            out = dense_causal_attention(q, k, v)
+        else:
+            idx = cache_index.value
+            ck = jax.lax.dynamic_update_slice(
+                cached_key.value, k.astype(self.dtype), (0, 0, idx, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cached_value.value, v.astype(self.dtype), (0, 0, idx, 0)
+            )
+            cached_key.value = ck
+            cached_value.value = cv
+            cache_index.value = idx + seq
+            max_len = ck.shape[2]
+            scale = 1.0 / np.sqrt(head_dim)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * scale
+            # Query at relative position r sees cache slots <= idx + r
+            # (causal within the prefill block, everything cached before).
+            valid = (
+                jnp.arange(max_len)[None, :]
+                <= idx + jnp.arange(seq)[:, None]
+            )
+            scores = jnp.where(
+                valid[None, None], scores, jnp.finfo(scores.dtype).min
+            )
+            weights = nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", weights, cv)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(
+            x.shape[0], x.shape[1], d_model
+        )
+        return nn.DenseGeneral(d_model, dtype=self.dtype, name="out")(out)
+
 
 class Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dtype: Any = jnp.float32
     attention: str = "dense"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x):
         d_model = x.shape[-1]
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x + SelfAttention(self.num_heads, dtype=self.dtype,
-                              attention=self.attention)(y)
+                              attention=self.attention,
+                              decode=self.decode)(y)
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         h = nn.Dense(d_model * self.mlp_ratio, dtype=self.dtype)(y)
         h = nn.gelu(h)
@@ -130,6 +195,7 @@ class TransformerLM(nn.Module):
     max_seq_len: int = 2048
     dtype: Any = jnp.float32
     attention: str = "dense"
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -142,6 +208,8 @@ class TransformerLM(nn.Module):
             nn.initializers.normal(0.02),
             (self.max_seq_len, self.d_model),
         )
+        if self.decode:
+            return self._decode_forward(tokens, x, pos, seq)
         import jax
 
         from elephas_tpu.parallel.ring_attention import (
@@ -170,6 +238,134 @@ class TransformerLM(nn.Module):
         x = nn.LayerNorm(dtype=jnp.float32)(x.astype(jnp.float32))
         # Next-token logits, tied head kept separate for simplicity.
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
+
+    def _decode_forward(self, tokens, x, pos, seq):
+        """Incremental forward for sampling: positional embedding from a
+        module-level position counter (advanced by each apply's block
+        length — the batched prompt prefill, then one token per sampling
+        step), ordinary blocks with KV-cache attention. Init pass
+        (full-length dummy) shapes the caches and the parameter tree
+        identically to the training model, so trained params drop in."""
+        init_pass = not self.has_variable("cache", "pos_index")
+        pos_index = self.variable(
+            "cache", "pos_index", lambda: jnp.array(0, jnp.int32)
+        )
+        if init_pass:
+            x = (x + pos[:seq]).astype(self.dtype)
+        else:
+            idx = pos_index.value
+            pos_index.value = idx + seq
+            x = (
+                x + jax.lax.dynamic_slice_in_dim(pos, idx, seq, axis=0)
+            ).astype(self.dtype)
+        for _ in range(self.num_layers):
+            x = Block(self.num_heads, dtype=self.dtype, attention="dense",
+                      decode=True)(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x.astype(jnp.float32))
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("module", "max_new", "greedy")
+)
+def _generate_scan(module, params, prompt, cache, rng, max_new, greedy,
+                   temperature):
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(key, logits / temperature).astype(
+            jnp.int32
+        )
+
+    # PREFILL: one batched forward over the whole prompt fills every
+    # layer's cache in parallel — O(plen) sequential single-token steps
+    # would dominate long-context generation.
+    logits, mutated = module.apply(
+        {"params": params, "cache": cache}, prompt, mutable=["cache"]
+    )
+    rng, key = jax.random.split(rng)
+    first = sample(logits[:, -1, :], key)
+
+    def step(carry, _):
+        tok, cache, rng = carry
+        logits, mutated = module.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            mutable=["cache"],
+        )
+        rng, key = jax.random.split(rng)
+        nxt = sample(logits[:, 0, :], key)
+        return (nxt, mutated["cache"], rng), nxt
+
+    (_, _, _), rest = jax.lax.scan(
+        step, (first, mutated["cache"], rng), None, length=max_new - 1
+    )
+    return jnp.concatenate([prompt, first[:, None], rest.T], axis=1)
+
+
+def generate(
+    compiled,
+    prompt,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    seed: int = 0,
+    params=None,
+):
+    """Autoregressive sampling from a ``TransformerLM`` — the inference
+    half of the long-context story (absent in the reference, which has
+    no generative models at all; SURVEY.md §5.7).
+
+    ``prompt``: (batch, prompt_len) int tokens. Returns
+    (batch, prompt_len + max_new_tokens) tokens including the prompt.
+    Greedy at ``temperature=0`` (default), categorical otherwise
+    (temperature is a traced operand — sweeping it never recompiles).
+
+    KV-cache incremental decoding: one batched PREFILL forward fills
+    every layer's cache over the prompt, then one O(L·d) forward per
+    sampled token, the whole loop one compiled program. Trained
+    parameters drop in unchanged — the decode path shapes an identical
+    parameter tree; models trained with ring/ulysses/flash attention
+    sample through the cache path (same math, single device).
+    """
+    module = compiled.module
+    if not isinstance(module, TransformerLM):
+        raise TypeError(
+            f"generate() samples TransformerLM models, got {type(module).__name__}"
+        )
+    params = params if params is not None else compiled.params
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim != 2 or prompt.shape[1] < 1:
+        raise ValueError(
+            f"prompt must be (batch, prompt_len>=1), got {prompt.shape}"
+        )
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    b, plen = prompt.shape
+    total = plen + max_new_tokens
+    if total > module.max_seq_len:
+        raise ValueError(
+            f"prompt_len {plen} + max_new_tokens {max_new_tokens} exceeds "
+            f"max_seq_len {module.max_seq_len}"
+        )
+    # decode=True with attention='dense': the cache path replaces the
+    # attention impl; sequence-parallel training configs sample fine.
+    decode_module = dataclasses.replace(module, decode=True, attention="dense")
+    # Zero caches straight from shapes (eval_shape: no param
+    # materialization, no full-length attention forward on dummies).
+    cache_shapes = jax.eval_shape(
+        lambda: decode_module.init(
+            jax.random.PRNGKey(0), jnp.zeros((b, total), jnp.int32)
+        )
+    )["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
+    out = _generate_scan(
+        decode_module, params, prompt, cache,
+        jax.random.PRNGKey(seed), max_new_tokens,
+        float(temperature) <= 0.0, jnp.float32(temperature),
+    )
+    return np.asarray(out)
 
 
 @register_model("transformer_lm")

@@ -1,0 +1,165 @@
+"""TransformerLM autoregressive sampling (KV-cache incremental decode).
+
+The reference has no generative models (SURVEY.md §5.7); this pins the
+inference half of the long-context story: the cached decode path is
+numerically the full forward, and a trained model's samples follow the
+structure it learned.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.models import get_model
+from elephas_tpu.models.transformer import generate
+
+VOCAB, SEQ = 64, 32
+
+
+def _compiled(attention="dense", **kw):
+    return CompiledModel(
+        get_model(
+            "transformer_lm", vocab_size=VOCAB, d_model=32, num_heads=4,
+            num_layers=2, max_seq_len=SEQ, attention=attention, **kw,
+        ),
+        optimizer={"name": "adam", "learning_rate": 3e-3},
+        loss="sparse_categorical_crossentropy",
+        metrics=[],
+        input_shape=(SEQ,),
+        input_dtype=jnp.int32,
+        seed=0,
+    )
+
+
+def test_incremental_decode_matches_full_forward():
+    """Per-position logits from the KV-cache path equal the ordinary
+    full-context forward — the cache is an optimization, never math."""
+    compiled = _compiled()
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, VOCAB, size=(2, SEQ), dtype=np.int32)
+    )
+    full = compiled.apply_eval(compiled.params, {}, tokens)
+
+    module = dataclasses.replace(compiled.module, decode=True)
+    cache = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, SEQ), jnp.int32)
+    )["cache"]
+    steps = []
+    for t in range(SEQ):
+        logits, mutated = module.apply(
+            {"params": compiled.params, "cache": cache},
+            tokens[:, t:t + 1],
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        steps.append(np.asarray(logits[:, 0]))
+    incremental = np.stack(steps, axis=1)
+    np.testing.assert_allclose(
+        incremental, np.asarray(full), rtol=2e-4, atol=2e-4
+    )
+
+    # Batched PREFILL (one apply over the whole prompt) is the same math
+    # as both of the above — it's what generate() runs over the prompt.
+    cache2 = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, SEQ), jnp.int32)
+    )["cache"]
+    prefill, _ = module.apply(
+        {"params": compiled.params, "cache": cache2}, tokens,
+        mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(prefill), np.asarray(full), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_generate_greedy_follows_learned_recurrence():
+    """Train on token[i] = token[i-1] + token[i-2] (mod vocab), then
+    greedy-generate: the continuation must follow the recurrence for
+    most positions — proof the sampler really runs the trained model."""
+    from elephas_tpu.engine.step import init_train_state, make_train_step
+
+    compiled = _compiled()
+    rng = np.random.default_rng(1)
+    base = rng.integers(0, VOCAB, size=(16, SEQ + 1)).astype(np.int32)
+    for i in range(2, SEQ + 1):
+        base[:, i] = (base[:, i - 1] + base[:, i - 2]) % VOCAB
+
+    step = jax.jit(make_train_step(compiled))
+    state = init_train_state(compiled)
+    x, t = jnp.asarray(base[:, :-1]), jnp.asarray(base[:, 1:])
+    for _ in range(60):
+        state, metrics = step(state, x, t)
+    assert float(metrics["loss"]) < 1.0  # learned the recurrence
+
+    # Prompt with TRAINING-ROW prefixes: a 16-row toy fit memorizes its
+    # corpus rather than abstracting mod-64 addition, so generalization
+    # to arbitrary seeds is not what this pins — the sampler faithfully
+    # continuing sequences the model knows is.
+    prompt = base[:3, :4].copy()
+    out = generate(compiled, prompt, max_new_tokens=12, params=state.params)
+    assert out.shape == (3, 16)
+    assert np.array_equal(out[:, :4], prompt)  # prompt preserved
+    want_hits = 0
+    total = 0
+    for row in out:
+        for i in range(4, len(row)):
+            want_hits += int(row[i] == (row[i - 1] + row[i - 2]) % VOCAB)
+            total += 1
+    assert want_hits / total > 0.7, f"{want_hits}/{total} follow the recurrence"
+
+
+def test_generate_temperature_and_determinism():
+    compiled = _compiled()
+    prompt = np.zeros((2, 3), dtype=np.int32)
+    a = generate(compiled, prompt, max_new_tokens=5, temperature=1.0, seed=4)
+    b = generate(compiled, prompt, max_new_tokens=5, temperature=1.0, seed=4)
+    c = generate(compiled, prompt, max_new_tokens=5, temperature=1.0, seed=5)
+    np.testing.assert_array_equal(a, b)  # same seed, same sample
+    assert a.shape == c.shape == (2, 8)
+
+
+def test_generate_validates_inputs():
+    compiled = _compiled()
+    with pytest.raises(ValueError, match="exceeds max_seq_len"):
+        generate(compiled, np.zeros((1, 4), np.int32), max_new_tokens=SEQ)
+    with pytest.raises(ValueError, match="prompt must be"):
+        generate(compiled, np.zeros((4,), np.int32), max_new_tokens=2)
+
+    mlp = CompiledModel(
+        get_model("mlp", features=(8,), num_classes=4),
+        optimizer="sgd", loss="categorical_crossentropy", metrics=[],
+        input_shape=(6,),
+    )
+    with pytest.raises(TypeError, match="TransformerLM"):
+        generate(mlp, np.zeros((1, 2), np.int32), max_new_tokens=2)
+
+
+def test_generate_from_sequence_parallel_trained_model():
+    """A model TRAINED with attention='ring' under dp×sp samples through
+    the cache path unchanged (identical parameter tree) — train to low
+    loss on the recurrence, then generate follows it."""
+    from elephas_tpu.parallel.mesh import build_mesh
+    from elephas_tpu.parallel.seq_parallel import SeqParallelTrainer
+
+    compiled = _compiled("ring")
+    mesh = build_mesh(num_data=2, num_seq=4)
+    rng = np.random.default_rng(2)
+    base = rng.integers(0, VOCAB, size=(16, SEQ + 1)).astype(np.int32)
+    for i in range(2, SEQ + 1):
+        base[:, i] = (base[:, i - 1] + base[:, i - 2]) % VOCAB
+    trainer = SeqParallelTrainer(compiled, mesh)
+    state, history = trainer.fit(base, epochs=60, batch_size=16)
+    assert history["loss"][-1] < 1.0
+
+    prompt = base[:1, :4].copy()  # training-row prefix (memorized corpus)
+    out = generate(compiled, prompt, max_new_tokens=10, params=state.params)
+    hits = sum(
+        int(out[0, i] == (out[0, i - 1] + out[0, i - 2]) % VOCAB)
+        for i in range(4, out.shape[1])
+    )
+    assert hits / (out.shape[1] - 4) > 0.7
