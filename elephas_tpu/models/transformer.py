@@ -210,8 +210,6 @@ class TransformerLM(nn.Module):
         )
         if self.decode:
             return self._decode_forward(tokens, x, pos, seq)
-        import jax
-
         from elephas_tpu.parallel.ring_attention import (
             require_seq_axis,
             seq_axis_size_or_none,
@@ -266,13 +264,22 @@ class TransformerLM(nn.Module):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("module", "max_new", "greedy")
+    jax.jit, static_argnames=("module", "max_new", "greedy", "top_k")
 )
 def _generate_scan(module, params, prompt, cache, rng, max_new, greedy,
-                   temperature):
+                   top_k, temperature):
     def sample(logits, key):
         if greedy:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if top_k:
+            # Keep the k highest logits, mask the rest to -inf: the
+            # standard tail-truncation that stops temperature sampling
+            # from wandering off the model's manifold. lax.top_k is
+            # O(V) per step vs a full sort's O(V log V).
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(
+                logits >= kth, logits, jnp.finfo(logits.dtype).min
+            )
         return jax.random.categorical(key, logits / temperature).astype(
             jnp.int32
         )
@@ -308,6 +315,7 @@ def generate(
     prompt,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int = 0,
     seed: int = 0,
     params=None,
 ):
@@ -318,7 +326,8 @@ def generate(
     ``prompt``: (batch, prompt_len) int tokens. Returns
     (batch, prompt_len + max_new_tokens) tokens including the prompt.
     Greedy at ``temperature=0`` (default), categorical otherwise
-    (temperature is a traced operand — sweeping it never recompiles).
+    (temperature is a traced operand — sweeping it never recompiles);
+    ``top_k > 0`` truncates sampling to the k most likely tokens.
 
     KV-cache incremental decoding: one batched PREFILL forward fills
     every layer's cache over the prompt, then one O(L·d) forward per
@@ -340,6 +349,10 @@ def generate(
         )
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if not 0 <= top_k <= module.vocab_size:
+        raise ValueError(
+            f"top_k must be in [0, vocab_size={module.vocab_size}], got {top_k}"
+        )
     b, plen = prompt.shape
     total = plen + max_new_tokens
     if total > module.max_seq_len:
@@ -363,7 +376,7 @@ def generate(
     out = _generate_scan(
         decode_module, params, prompt, cache,
         jax.random.PRNGKey(seed), max_new_tokens,
-        float(temperature) <= 0.0, jnp.float32(temperature),
+        float(temperature) <= 0.0, int(top_k), jnp.float32(temperature),
     )
     return np.asarray(out)
 

@@ -123,6 +123,20 @@ def test_generate_temperature_and_determinism():
     assert a.shape == c.shape == (2, 8)
 
 
+def test_generate_top_k_one_is_greedy():
+    """top_k=1 collapses categorical sampling onto the argmax at ANY
+    temperature — the truncation really gates what can be drawn."""
+    compiled = _compiled()
+    prompt = np.arange(6, dtype=np.int32).reshape(2, 3)
+    greedy = generate(compiled, prompt, max_new_tokens=6, temperature=0.0)
+    topk1 = generate(
+        compiled, prompt, max_new_tokens=6, temperature=2.0, top_k=1, seed=9
+    )
+    np.testing.assert_array_equal(greedy, topk1)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(compiled, prompt, max_new_tokens=2, top_k=VOCAB + 1)
+
+
 def test_generate_validates_inputs():
     compiled = _compiled()
     with pytest.raises(ValueError, match="exceeds max_seq_len"):
