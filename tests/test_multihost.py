@@ -246,7 +246,7 @@ elif kind == "sptp":
     assert qkv.sharding.shard_shape(qkv.shape)[2] == qkv.shape[2] // 2
     tokens = rng.integers(0, 64, size=(4, seq + 1), dtype=np.int32)
     x, t = shard_lm_batch(mesh, tokens[:, :-1], tokens[:, 1:])
-else:  # kind == "tp": dp x tp GSPMD with Megatron-style param shardings
+elif kind == "tp":  # dp x tp GSPMD with Megatron-style param shardings
     from elephas_tpu.parallel.tensor_parallel import (
         init_lm_state_tp, make_lm_train_step_tp,
     )
@@ -267,16 +267,38 @@ else:  # kind == "tp": dp x tp GSPMD with Megatron-style param shardings
     x = jax.device_put(tokens[:, :-1], sh)
     t = jax.device_put(tokens[:, 1:], sh)
 
-losses = []
-for _ in range(5):
-    state, metrics = step(state, x, t)
-    losses.append(float(metrics["loss"]))
-assert int(state.step) == 5
+if kind == "trainer":
+    # The fit-shaped driver itself across processes: host-side epoch
+    # loop, rank-identical shuffle schedule, per-epoch validation.
+    from elephas_tpu.parallel.seq_parallel import SeqParallelTrainer
+
+    mesh = build_mesh(num_data=2, num_seq=4)
+    seq = 32
+    compiled = CompiledModel(
+        get_model("transformer_lm", vocab_size=64, d_model=16, num_heads=4,
+                  num_layers=1, max_seq_len=seq, attention="auto"),
+        optimizer={"name": "adam", "learning_rate": 1e-2},
+        loss="sparse_categorical_crossentropy",
+        metrics=[], input_shape=(seq,), input_dtype=jnp.int32, seed=0,
+    )
+    corpus = rng.integers(0, 64, size=(16, seq + 1), dtype=np.int32)
+    trainer = SeqParallelTrainer(compiled, mesh)
+    state, history = trainer.fit(
+        corpus, epochs=3, batch_size=8, validation_tokens=corpus[:8],
+    )
+    assert int(state.step) == 6
+    losses = history["loss"] + history["val_loss"]
+else:
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, x, t)
+        losses.append(float(metrics["loss"]))
+    assert int(state.step) == 5
 print("RESULT " + json.dumps({"proc": idx, "losses": losses}))
 """
 
 
-@pytest.mark.parametrize("kind", ["ring", "ulysses", "tp", "sptp"])
+@pytest.mark.parametrize("kind", ["ring", "ulysses", "tp", "sptp", "trainer"])
 def test_two_process_seq_and_tensor_parallel(tmp_path, kind):
     """The beyond-parity parallelism paths crossing REAL process
     boundaries (VERDICT r4 #1): dp x sp LM steps (ring ppermute and
