@@ -48,4 +48,7 @@ from elephas_tpu.models.mlp import MLP  # noqa: E402,F401
 from elephas_tpu.models.cnn import SimpleCNN  # noqa: E402,F401
 from elephas_tpu.models.resnet import ResNet18  # noqa: E402,F401
 from elephas_tpu.models.lstm import LSTMClassifier  # noqa: E402,F401
-from elephas_tpu.models.transformer import TransformerLM  # noqa: E402,F401
+from elephas_tpu.models.transformer import (  # noqa: E402,F401
+    TransformerLM,
+    generate,
+)

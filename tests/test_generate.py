@@ -123,6 +123,20 @@ def test_generate_temperature_and_determinism():
     assert a.shape == c.shape == (2, 8)
 
 
+def test_generate_bf16_model_and_namespace_export():
+    """bf16-dtype models decode through the cache path (the caches
+    inherit the model dtype), and ``generate`` is importable from the
+    models namespace."""
+    from elephas_tpu.models import generate as ns_generate
+
+    compiled = _compiled(dtype=jnp.bfloat16)
+    out = ns_generate(
+        compiled, np.zeros((2, 3), np.int32), max_new_tokens=4
+    )
+    assert out.shape == (2, 7)
+    assert (out >= 0).all() and (out < VOCAB).all()
+
+
 def test_generate_top_k_one_is_greedy():
     """top_k=1 collapses categorical sampling onto the argmax at ANY
     temperature — the truncation really gates what can be drawn."""
