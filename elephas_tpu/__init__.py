@@ -35,3 +35,4 @@ from elephas_tpu.data.rdd import ShardedDataset, to_simple_rdd  # noqa: F401
 from elephas_tpu.data.dataframe import DataFrame  # noqa: F401
 from elephas_tpu.ml import ElephasEstimator, ElephasTransformer  # noqa: F401
 from elephas_tpu.hyperparam import HyperParamModel, hp  # noqa: F401
+from elephas_tpu.serving import InferenceEngine  # noqa: F401

@@ -1,6 +1,11 @@
 """Metrics / logging / observability (SURVEY.md §5.5) and profiling hooks
 (SURVEY.md §5.1 — the reference has neither; users got the Spark web UI)."""
 
+from elephas_tpu.metrics.flops import (  # noqa: F401
+    mfu,
+    peak_flops,
+    transformer_flops_per_token,
+)
 from elephas_tpu.metrics.logging import (  # noqa: F401
     JsonlSink,
     Throughput,

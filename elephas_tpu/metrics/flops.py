@@ -1,0 +1,87 @@
+"""Model-FLOPs-utilization accounting for benchmark scripts.
+
+MFU = achieved model FLOPs/sec ÷ the chip's peak FLOPs/sec — the
+standard "how much of the accelerator are we actually using" number
+(PaLM appendix B). Model FLOPs count only the mathematically necessary
+work (no recomputation, no padding), so MFU is comparable across
+implementations in a way raw tokens/sec is not.
+
+``transformer_flops_per_token`` uses the 6N-parameters-per-token rule
+for the matmul work (2N forward, 4N backward; inference = 2N) plus the
+attention term ``12 · layers · d_model · seq`` that 6N misses (it scales
+with CONTEXT, not parameters — dominant exactly in the long-context
+regime this repo targets).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# Peak dense-matmul FLOPs/sec by accelerator kind (bf16, no sparsity) —
+# published spec sheets. ``device_kind`` strings as jax.devices() reports
+# them; matching is substring-based so e.g. "TPU v4" hits "tpu v4".
+PEAK_FLOPS: dict = {
+    "tpu v3": 123e12,
+    "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,
+    "tpu v5e": 197e12,
+    "tpu v5p": 459e12,
+    "tpu v6e": 918e12,
+    "a100": 312e12,
+    "h100": 989e12,
+}
+
+
+def transformer_flops_per_token(
+    num_params: int,
+    num_layers: int,
+    d_model: int,
+    seq_len: int,
+    *,
+    backward: bool = False,
+) -> float:
+    """Model FLOPs one token costs a decoder-only transformer.
+
+    ``2 * num_params`` matmul FLOPs forward (multiply+add per weight),
+    tripled when ``backward`` (dL/dx and dL/dW each cost a forward), plus
+    the attention score/value work ``12 * layers * d_model * seq_len``
+    forward (QK^T and AV are each ``2 * d_model * seq`` per layer ×2 for
+    the multiply+add convention — doubled again under ``backward``).
+    For KV-cache decode, ``seq_len`` is the current context length.
+    """
+    matmul = 2.0 * num_params
+    attn = 12.0 * num_layers * d_model * seq_len
+    if backward:
+        matmul *= 3.0
+        attn *= 3.0
+    return matmul + attn
+
+
+def peak_flops(device_kind: Optional[str] = None) -> Optional[float]:
+    """Peak bf16 FLOPs/sec for ``device_kind`` (default: the current
+    backend's device), or None when the chip isn't in the table — CPU
+    above all, where MFU against a marketing number means nothing."""
+    if device_kind is None:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind
+    kind = device_kind.lower()
+    for name, flops in PEAK_FLOPS.items():
+        if name in kind:
+            return flops
+    return None
+
+
+def mfu(
+    tokens_per_sec: float,
+    flops_per_token: float,
+    peak: Optional[float] = None,
+) -> Optional[float]:
+    """Model FLOPs utilization in [0, 1], or None when peak FLOPs are
+    unknown (see ``peak_flops``). ``flops_per_token`` comes from
+    ``transformer_flops_per_token`` (or any model-specific count)."""
+    if peak is None:
+        peak = peak_flops()
+    if peak is None or peak <= 0 or tokens_per_sec < 0:
+        return None
+    return tokens_per_sec * flops_per_token / peak

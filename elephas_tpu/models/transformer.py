@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -50,7 +50,7 @@ class SelfAttention(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pad_offset=None):
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
         qkv = nn.DenseGeneral((3, self.num_heads, head_dim), dtype=self.dtype,
@@ -60,7 +60,7 @@ class SelfAttention(nn.Module):
         k = jnp.transpose(k, (0, 2, 1, 3))
         v = jnp.transpose(v, (0, 2, 1, 3))
         if self.decode:
-            return self._decode_attend(x, q, k, v, d_model)
+            return self._decode_attend(x, q, k, v, d_model, pad_offset)
         attention = self.attention
         if attention == "auto" and not self.is_initializing():
             # Resolved at trace time (axis size is static): sequence-
@@ -109,7 +109,7 @@ class SelfAttention(nn.Module):
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(x.shape[0], x.shape[1], d_model)
         return nn.DenseGeneral(d_model, dtype=self.dtype, name="out")(out)
 
-    def _decode_attend(self, x, q, k, v, d_model):
+    def _decode_attend(self, x, q, k, v, d_model, pad_offset=None):
         """Incremental (KV-cache) attention for autoregressive sampling.
 
         The cache is SHAPED on the init pass (which feeds a full-length
@@ -117,8 +117,13 @@ class SelfAttention(nn.Module):
         the current block's k/v land at ``cache_index`` (seq may be >1 —
         batched PREFILL fills the whole prompt in one forward — or 1 per
         sampling step), and each query attends over everything cached up
-        to its own position. Training never touches this path — it
-        exists for ``generate`` (below)."""
+        to its own position. ``cache_index`` is a scalar () when every
+        row writes the same column (``generate`` — left-padding aligns
+        the batch) or a (batch,) vector of independent per-row columns
+        (the serving KV pool, where each slot is mid-decode at its own
+        depth). ``pad_offset`` (batch,) masks each row's leading
+        left-pad columns out of attention. Training never touches this
+        path — it exists for ``generate`` and ``serving``."""
         b, h, seq, head_dim = q.shape
         init_pass = not self.has_variable("cache", "cached_key")
         cached_key = self.variable(
@@ -137,30 +142,46 @@ class SelfAttention(nn.Module):
             # zeroed at the full length.
             out = dense_causal_attention(q, k, v)
         else:
+            from elephas_tpu.ops.attention import cache_attention_mask
+
             idx = cache_index.value
-            ck = jax.lax.dynamic_update_slice(
-                cached_key.value, k.astype(self.dtype), (0, 0, idx, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cached_value.value, v.astype(self.dtype), (0, 0, idx, 0)
-            )
+            if idx.ndim == 0:
+                ck = jax.lax.dynamic_update_slice(
+                    cached_key.value, k.astype(self.dtype), (0, 0, idx, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cached_value.value, v.astype(self.dtype), (0, 0, idx, 0)
+                )
+            else:
+                # Per-row write positions: one scatter per row (vmapped
+                # dynamic_update_slice over the batch dim).
+                row_update = jax.vmap(
+                    lambda cache, blk, i: jax.lax.dynamic_update_slice(
+                        cache, blk, (0, i, 0)
+                    )
+                )
+                ck = row_update(cached_key.value, k.astype(self.dtype), idx)
+                cv = row_update(cached_value.value, v.astype(self.dtype), idx)
             cached_key.value = ck
             cached_value.value = cv
             cache_index.value = idx + seq
             max_len = ck.shape[2]
             scale = 1.0 / np.sqrt(head_dim)
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * scale
-            # Query at relative position r sees cache slots <= idx + r
-            # (causal within the prefill block, everything cached before).
-            valid = (
-                jnp.arange(max_len)[None, :]
-                <= idx + jnp.arange(seq)[:, None]
-            )
-            scores = jnp.where(
-                valid[None, None], scores, jnp.finfo(scores.dtype).min
-            )
+            valid = cache_attention_mask(max_len, seq, idx, pad_offset)
+            scores = jnp.where(valid, scores, jnp.finfo(scores.dtype).min)
             weights = nn.softmax(scores, axis=-1)
             out = jnp.einsum("bhqk,bhkd->bhqd", weights, cv)
+            if pad_offset is not None:
+                # Queries at left-pad columns have NO valid key (their
+                # softmax row is all -inf → NaN). Zero them so the pad
+                # columns' residual stream stays finite — otherwise the
+                # NEXT layer caches NaN keys there and 0-weight * NaN
+                # poisons every real query downstream.
+                qcols = idx + jnp.arange(seq) if jnp.asarray(idx).ndim == 0 \
+                    else idx[:, None] + jnp.arange(seq)[None, :]
+                qpad = qcols < pad_offset[:, None]  # (batch, seq)
+                out = jnp.where(qpad[:, None, :, None], 0.0, out)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(
             x.shape[0], x.shape[1], d_model
         )
@@ -175,12 +196,12 @@ class Block(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pad_offset=None):
         d_model = x.shape[-1]
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x + SelfAttention(self.num_heads, dtype=self.dtype,
                               attention=self.attention,
-                              decode=self.decode)(y)
+                              decode=self.decode)(y, pad_offset=pad_offset)
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         h = nn.Dense(d_model * self.mlp_ratio, dtype=self.dtype)(y)
         h = nn.gelu(h)
@@ -198,7 +219,7 @@ class TransformerLM(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, pad_offset=None):
         seq = tokens.shape[1]
         x = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(
             tokens.astype(jnp.int32)
@@ -209,7 +230,12 @@ class TransformerLM(nn.Module):
             (self.max_seq_len, self.d_model),
         )
         if self.decode:
-            return self._decode_forward(tokens, x, pos, seq)
+            return self._decode_forward(tokens, x, pos, seq, pad_offset)
+        if pad_offset is not None:
+            raise ValueError(
+                "pad_offset (ragged left-padded batches) is only supported "
+                "on the decode=True path"
+            )
         from elephas_tpu.parallel.ring_attention import (
             require_seq_axis,
             seq_axis_size_or_none,
@@ -237,13 +263,20 @@ class TransformerLM(nn.Module):
         # Next-token logits, tied head kept separate for simplicity.
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
 
-    def _decode_forward(self, tokens, x, pos, seq):
+    def _decode_forward(self, tokens, x, pos, seq, pad_offset=None):
         """Incremental forward for sampling: positional embedding from a
         module-level position counter (advanced by each apply's block
         length — the batched prompt prefill, then one token per sampling
         step), ordinary blocks with KV-cache attention. Init pass
         (full-length dummy) shapes the caches and the parameter tree
-        identically to the training model, so trained params drop in."""
+        identically to the training model, so trained params drop in.
+
+        ``pos_index`` mirrors the layers' ``cache_index``: scalar for
+        the aligned ``generate`` batch, (batch,) per-row for serving
+        slots. With ``pad_offset`` set, a row's REAL position is its
+        cache column minus its left-pad count, so a ragged row embeds
+        its first real token at position 0 — token-identical to
+        decoding that row alone."""
         init_pass = not self.has_variable("cache", "pos_index")
         pos_index = self.variable(
             "cache", "pos_index", lambda: jnp.array(0, jnp.int32)
@@ -253,61 +286,137 @@ class TransformerLM(nn.Module):
         else:
             idx = pos_index.value
             pos_index.value = idx + seq
-            x = (
-                x + jax.lax.dynamic_slice_in_dim(pos, idx, seq, axis=0)
-            ).astype(self.dtype)
+            if idx.ndim == 0 and pad_offset is None:
+                x = (
+                    x + jax.lax.dynamic_slice_in_dim(pos, idx, seq, axis=0)
+                ).astype(self.dtype)
+            else:
+                cols = idx[..., None] + jnp.arange(seq)  # (seq,) or (b, seq)
+                if pad_offset is not None:
+                    cols = cols - pad_offset[:, None]
+                # Pad columns clip to position 0 — their embeddings are
+                # garbage but masked out of every real query's attention.
+                cols = jnp.clip(cols, 0, self.max_seq_len - 1)
+                x = (x + jnp.take(pos, cols, axis=0)).astype(self.dtype)
         for _ in range(self.num_layers):
             x = Block(self.num_heads, dtype=self.dtype, attention="dense",
-                      decode=True)(x)
+                      decode=True)(x, pad_offset=pad_offset)
         x = nn.LayerNorm(dtype=jnp.float32)(x.astype(jnp.float32))
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
 
 
+def sample_tokens(logits, key, greedy, top_k, temperature):
+    """Shared sampling head for ``generate`` and the serving engine:
+    greedy argmax, or top-k-truncated categorical at ``temperature``.
+    ``greedy``/``top_k`` must be trace-time constants."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k:
+        # Keep the k highest logits, mask the rest to -inf: the
+        # standard tail-truncation that stops temperature sampling
+        # from wandering off the model's manifold. lax.top_k is
+        # O(V) per step vs a full sort's O(V log V).
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(
+            logits >= kth, logits, jnp.finfo(logits.dtype).min
+        )
+    return jax.random.categorical(key, logits / temperature).astype(
+        jnp.int32
+    )
+
+
+# Trace-time counter: the traced body runs ONCE per compilation, so this
+# counts compiles — tests assert ragged batches of varying lengths reuse
+# one program (recompiles only on genuine shape/static changes).
+_GENERATE_TRACES = 0
+
+
+def generate_trace_count() -> int:
+    """How many times the generate program has been (re)compiled."""
+    return _GENERATE_TRACES
+
+
 @functools.partial(
-    jax.jit, static_argnames=("module", "max_new", "greedy", "top_k")
+    jax.jit,
+    static_argnames=("module", "max_new", "greedy", "top_k", "use_stop"),
 )
 def _generate_scan(module, params, prompt, cache, rng, max_new, greedy,
-                   top_k, temperature):
+                   top_k, temperature, pad_offset, stop_token, use_stop):
+    global _GENERATE_TRACES
+    _GENERATE_TRACES += 1
+
     def sample(logits, key):
-        if greedy:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if top_k:
-            # Keep the k highest logits, mask the rest to -inf: the
-            # standard tail-truncation that stops temperature sampling
-            # from wandering off the model's manifold. lax.top_k is
-            # O(V) per step vs a full sort's O(V log V).
-            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-            logits = jnp.where(
-                logits >= kth, logits, jnp.finfo(logits.dtype).min
-            )
-        return jax.random.categorical(key, logits / temperature).astype(
-            jnp.int32
-        )
+        return sample_tokens(logits, key, greedy, top_k, temperature)
 
     # PREFILL: one batched forward over the whole prompt fills every
     # layer's cache in parallel — O(plen) sequential single-token steps
     # would dominate long-context generation.
     logits, mutated = module.apply(
-        {"params": params, "cache": cache}, prompt, mutable=["cache"]
+        {"params": params, "cache": cache}, prompt, mutable=["cache"],
+        pad_offset=pad_offset,
     )
     rng, key = jax.random.split(rng)
     first = sample(logits[:, -1, :], key)
+    done = (first == stop_token) if use_stop else jnp.zeros(
+        first.shape, bool
+    )
 
     def step(carry, _):
-        tok, cache, rng = carry
+        tok, cache, rng, done = carry
         logits, mutated = module.apply(
             {"params": params, "cache": cache},
             tok[:, None],
             mutable=["cache"],
+            pad_offset=pad_offset,
         )
         rng, key = jax.random.split(rng)
         nxt = sample(logits[:, 0, :], key)
-        return (nxt, mutated["cache"], rng), nxt
+        if use_stop:
+            # A finished row keeps emitting stop_token and stops
+            # advancing — its output is frozen, per-row early stop
+            # under one fixed-trip-count compiled program.
+            nxt = jnp.where(done, stop_token, nxt)
+            done = done | (nxt == stop_token)
+        return (nxt, mutated["cache"], rng, done), nxt
 
-    (_, _, _), rest = jax.lax.scan(
-        step, (first, mutated["cache"], rng), None, length=max_new - 1
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (first, mutated["cache"], rng, done), None, length=max_new - 1
     )
     return jnp.concatenate([prompt, first[:, None], rest.T], axis=1)
+
+
+def left_pad_prompts(prompts, pad_token: int = 0):
+    """Left-pad a ragged batch of prompts to a (batch, max_len) array.
+
+    ``prompts``: sequence of 1-D int token sequences (possibly of
+    different lengths). Returns ``(padded, lengths)`` — real tokens of
+    row ``i`` occupy the LAST ``lengths[i]`` columns, so every row's
+    final prompt token lands in the same column and the whole batch
+    decodes under one compiled program.
+    """
+    rows = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+    if any(len(r) < 1 for r in rows):
+        raise ValueError("every prompt must have at least 1 token")
+    lengths = np.array([len(r) for r in rows], np.int32)
+    plen = int(lengths.max())
+    padded = np.full((len(rows), plen), int(pad_token), np.int32)
+    for i, r in enumerate(rows):
+        padded[i, plen - len(r):] = r
+    return padded, lengths
+
+
+def make_decode_cache(decode_module, batch: int, total_len: int):
+    """Zeroed KV caches for ``total_len`` columns straight from shapes
+    (eval_shape: no param materialization, no full-length attention
+    forward on dummies)."""
+    cache_shapes = jax.eval_shape(
+        lambda: decode_module.init(
+            jax.random.PRNGKey(0), jnp.zeros((batch, total_len), jnp.int32)
+        )
+    )["cache"]
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
 
 
 def generate(
@@ -318,16 +427,30 @@ def generate(
     top_k: int = 0,
     seed: int = 0,
     params=None,
+    prompt_lengths=None,
+    stop_token: Optional[int] = None,
+    pad_token: int = 0,
 ):
     """Autoregressive sampling from a ``TransformerLM`` — the inference
     half of the long-context story (absent in the reference, which has
     no generative models at all; SURVEY.md §5.7).
 
-    ``prompt``: (batch, prompt_len) int tokens. Returns
-    (batch, prompt_len + max_new_tokens) tokens including the prompt.
-    Greedy at ``temperature=0`` (default), categorical otherwise
-    (temperature is a traced operand — sweeping it never recompiles);
-    ``top_k > 0`` truncates sampling to the k most likely tokens.
+    ``prompt``: (batch, prompt_len) int tokens, or a RAGGED batch — a
+    list/tuple of 1-D token sequences of different lengths, left-padded
+    here with ``pad_token`` (equivalently, pass a pre-padded 2-D array
+    plus ``prompt_lengths``). Ragged rows are masked through prefill
+    and cache (padding never attended, positions counted from each
+    row's first real token), so the output is token-identical to
+    decoding each row alone — under ONE compiled program for the padded
+    shape, no per-length recompiles (``generate_trace_count``).
+
+    ``stop_token``: per-row early stop — a row that emits it freezes
+    (keeps emitting ``stop_token``) while the rest of the batch decodes
+    on. Returns (batch, prompt_len + max_new_tokens) tokens including
+    the (padded) prompt. Greedy at ``temperature=0`` (default),
+    categorical otherwise (temperature is a traced operand — sweeping
+    it never recompiles); ``top_k > 0`` truncates sampling to the k
+    most likely tokens.
 
     KV-cache incremental decoding: one batched PREFILL forward fills
     every layer's cache over the prompt, then one O(L·d) forward per
@@ -342,6 +465,12 @@ def generate(
             f"generate() samples TransformerLM models, got {type(module).__name__}"
         )
     params = params if params is not None else compiled.params
+    if isinstance(prompt, (list, tuple)):
+        if prompt_lengths is not None:
+            raise ValueError(
+                "pass prompt_lengths only with a pre-padded 2-D prompt array"
+            )
+        prompt, prompt_lengths = left_pad_prompts(prompt, pad_token)
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim != 2 or prompt.shape[1] < 1:
         raise ValueError(
@@ -354,6 +483,25 @@ def generate(
             f"top_k must be in [0, vocab_size={module.vocab_size}], got {top_k}"
         )
     b, plen = prompt.shape
+    pad_offset = None
+    if prompt_lengths is not None:
+        lengths = np.asarray(prompt_lengths, np.int32).reshape(-1)
+        if lengths.shape != (b,):
+            raise ValueError(
+                f"prompt_lengths must have shape ({b},), got {lengths.shape}"
+            )
+        if (lengths < 1).any() or (lengths > plen).any():
+            raise ValueError(
+                f"prompt_lengths must be in [1, {plen}], got {lengths}"
+            )
+        # All-full-length batches keep the (faster) unmasked program.
+        if (lengths < plen).any():
+            pad_offset = jnp.asarray(plen - lengths)
+    if stop_token is not None and not 0 <= stop_token < module.vocab_size:
+        raise ValueError(
+            f"stop_token must be in [0, vocab_size={module.vocab_size}), "
+            f"got {stop_token}"
+        )
     total = plen + max_new_tokens
     if total > module.max_seq_len:
         raise ValueError(
@@ -363,20 +511,14 @@ def generate(
     # decode=True with attention='dense': the cache path replaces the
     # attention impl; sequence-parallel training configs sample fine.
     decode_module = dataclasses.replace(module, decode=True, attention="dense")
-    # Zero caches straight from shapes (eval_shape: no param
-    # materialization, no full-length attention forward on dummies).
-    cache_shapes = jax.eval_shape(
-        lambda: decode_module.init(
-            jax.random.PRNGKey(0), jnp.zeros((b, total), jnp.int32)
-        )
-    )["cache"]
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
-    )
+    cache = make_decode_cache(decode_module, b, total)
     out = _generate_scan(
         decode_module, params, prompt, cache,
         jax.random.PRNGKey(seed), max_new_tokens,
         float(temperature) <= 0.0, int(top_k), jnp.float32(temperature),
+        pad_offset,
+        jnp.int32(0 if stop_token is None else stop_token),
+        stop_token is not None,
     )
     return np.asarray(out)
 

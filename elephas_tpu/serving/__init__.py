@@ -1,0 +1,31 @@
+"""Online inference over the KV-cache decode path (SURVEY.md §5.7 —
+the reference has no generative models, let alone a serving story).
+
+The training half of the repo has its coordination service (the
+parameter server + engine drivers); this package is the inference
+counterpart — the subsystem that turns ``TransformerLM``'s compiled
+decode step into an engine that serves request traffic:
+
+- ``KVCachePool``      — a fixed-shape slot pool of per-layer KV caches;
+                         admission/eviction never reshapes the compiled
+                         decode program (``serving.kv_pool``),
+- ``ContinuousBatchingScheduler`` — bounded request queue, prefill/decode
+                         interleaving, deadline eviction, backpressure
+                         (``serving.scheduler``),
+- ``InferenceEngine``  — the frontend: ``submit()`` / ``result()`` /
+                         ``serve_forever()`` (``serving.engine``),
+- ``ServingMetrics``   — TTFT / inter-token latency / queue depth /
+                         tokens-per-sec through ``metrics.JsonlSink``
+                         (``serving.metrics``).
+"""
+
+from elephas_tpu.serving.kv_pool import KVCachePool  # noqa: F401
+from elephas_tpu.serving.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    GenerationResult,
+    QueueFull,
+    Request,
+    RequestQueue,
+)
+from elephas_tpu.serving.engine import InferenceEngine  # noqa: F401
+from elephas_tpu.serving.metrics import ServingMetrics  # noqa: F401

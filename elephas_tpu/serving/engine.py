@@ -1,0 +1,328 @@
+"""InferenceEngine — the serving frontend over the continuous-batching
+scheduler.
+
+Binds a ``CompiledModel`` (or bare ``TransformerLM`` + params) to TWO
+compiled programs that together serve arbitrary request traffic:
+
+- ``prefill``: batch-1, fixed ``max_prompt_len`` width (prompts are
+  left-padded into it), emits the first token and the prompt's KV cache;
+- ``decode``: one token for every pool slot per call, fixed
+  ``(max_slots,)`` shapes, per-slot cache positions.
+
+Admission, eviction, slot reuse and backpressure all happen HOST-side
+between calls — neither program ever retraces once warm, which is the
+entire point of the fixed-shape pool (``_prefill_traces`` /
+``_decode_traces`` count compilations; tests pin them to 1).
+
+Usage::
+
+    engine = InferenceEngine(compiled, max_slots=4, max_prompt_len=16,
+                             max_len=64, stop_token=eos)
+    rid = engine.submit([5, 3, 9], max_new_tokens=20)
+    result = engine.result(rid)          # drives steps inline, or waits
+    ...                                  # on a serve_forever thread
+    stop = threading.Event()
+    t = threading.Thread(target=engine.serve_forever, args=(stop,))
+
+``submit`` applies admission control (bounded queue) and raises
+``QueueFull`` with a ``retry_after`` hint; ``submit_with_retry`` wraps
+it in the same bounded-backoff loop the parameter-server client uses
+for connect (``parameter.client._RETRY_DELAYS``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from elephas_tpu.serving.kv_pool import KVCachePool
+from elephas_tpu.serving.metrics import ServingMetrics
+from elephas_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    GenerationResult,
+    QueueFull,
+    Request,
+    RequestQueue,
+)
+
+# Bounded backoff for submit_with_retry — same contract as the parameter
+# server client's connect loop: a handful of increasing delays, then the
+# error propagates.
+_RETRY_DELAYS = (0.1, 0.2, 0.4, 0.8, 1.3)
+
+
+class InferenceEngine:
+    """Online inference over a ``TransformerLM`` decode path.
+
+    Parameters
+    ----------
+    compiled: ``CompiledModel`` (module + params) or a flax
+        ``TransformerLM``; in the latter case pass ``params=``.
+    max_slots: concurrent sequences (decode batch width).
+    max_prompt_len: fixed prefill width; prompts are left-padded to it.
+    max_len: KV-cache columns per slot; a sequence may generate up to
+        ``max_len - max_prompt_len`` tokens.
+    stop_token: default EOS (per-request override via ``submit``).
+    queue_depth: admission-control bound on queued (unadmitted) requests.
+    temperature/top_k: 0/0 = greedy (default); otherwise sampled with an
+        engine-owned PRNG stream.
+    sink: optional ``metrics.JsonlSink`` for request/step records.
+    """
+
+    def __init__(
+        self,
+        compiled,
+        params=None,
+        *,
+        max_slots: int = 8,
+        max_prompt_len: int = 32,
+        max_len: int = 128,
+        stop_token: Optional[int] = None,
+        queue_depth: int = 16,
+        pad_token: int = 0,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: int = 0,
+        sink=None,
+        clock=time.monotonic,
+    ):
+        module = getattr(compiled, "module", compiled)
+        if params is None:
+            params = getattr(compiled, "params", None)
+        if params is None:
+            raise ValueError("need params (or a CompiledModel carrying them)")
+        if max_prompt_len >= max_len:
+            raise ValueError(
+                f"max_prompt_len ({max_prompt_len}) must leave room to "
+                f"generate within max_len ({max_len})"
+            )
+        if getattr(module, "max_seq_len", max_len) < max_len:
+            raise ValueError(
+                f"max_len ({max_len}) exceeds module.max_seq_len "
+                f"({module.max_seq_len})"
+            )
+        # The cache path replaces the training-time attention kernel
+        # wholesale, exactly as `models.transformer.generate` does.
+        self.decode_module = dataclasses.replace(
+            module, decode=True, attention="dense"
+        )
+        self.params = params
+        self.max_prompt_len = max_prompt_len
+        self.stop_token = stop_token
+        self.temperature = temperature
+        self.top_k = top_k
+        self.clock = clock
+        self._rng = jax.random.PRNGKey(seed)
+        self._greedy = temperature == 0.0
+
+        self.pool = KVCachePool(self.decode_module, max_slots, max_len)
+        self.queue = RequestQueue(max_depth=queue_depth)
+        self.metrics = ServingMetrics(sink=sink, clock=clock)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.pool,
+            self.queue,
+            self._prefill,
+            self._decode,
+            max_prompt_len=max_prompt_len,
+            pad_token=pad_token,
+            metrics=self.metrics,
+            clock=clock,
+        )
+
+        self._prefill_traces = 0
+        self._decode_traces = 0
+        self._jit_prefill = jax.jit(self._prefill_impl)
+        self._jit_decode = jax.jit(self._decode_impl)
+
+        self._req_ids = itertools.count()
+        self._results: Dict[int, GenerationResult] = {}
+        self._cond = threading.Condition()
+        self._step_lock = threading.Lock()
+
+    # -- compiled bodies ---------------------------------------------------
+
+    def _prefill_impl(self, params, prompt, pad_offset, rng):
+        # Traced once per compilation — the counter measures retraces.
+        self._prefill_traces += 1
+        from elephas_tpu.models.transformer import (
+            make_decode_cache,
+            sample_tokens,
+        )
+
+        cache = make_decode_cache(
+            self.decode_module, 1, self.pool.max_len
+        )
+        logits, mutated = self.decode_module.apply(
+            {"params": params, "cache": cache},
+            prompt,
+            pad_offset=pad_offset[None],
+            mutable=["cache"],
+        )
+        first = sample_tokens(
+            logits[:, -1], rng, self._greedy, self.top_k, self.temperature
+        )
+        return first[0], mutated["cache"]
+
+    def _decode_impl(self, params, cache, tokens, pad, rng):
+        self._decode_traces += 1
+        from elephas_tpu.models.transformer import sample_tokens
+
+        logits, mutated = self.decode_module.apply(
+            {"params": params, "cache": cache},
+            tokens[:, None],
+            pad_offset=pad,
+            mutable=["cache"],
+        )
+        nxt = sample_tokens(
+            logits[:, -1], rng, self._greedy, self.top_k, self.temperature
+        )
+        return nxt, mutated["cache"]
+
+    def _next_rng(self):
+        if self._greedy:
+            return self._rng  # unused by greedy sampling; keep it constant
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _prefill(self, prompt, pad_offset):
+        first, cache = self._jit_prefill(
+            self.params, prompt, pad_offset, self._next_rng()
+        )
+        return first, cache
+
+    def _decode(self, cache, tokens, pad):
+        nxt, new_cache = self._jit_decode(
+            self.params, cache, tokens, pad, self._next_rng()
+        )
+        return nxt, new_cache
+
+    # -- frontend ----------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int = 32,
+        stop_token: Optional[int] = "default",
+        timeout_s: Optional[float] = None,
+    ) -> int:
+        """Enqueue a request; returns its id. Raises ``QueueFull`` (with
+        ``.retry_after``) when admission control rejects it."""
+        prompt = [int(t) for t in prompt]
+        if not 1 <= len(prompt) <= self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} outside [1, "
+                f"{self.max_prompt_len}]"
+            )
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        now = self.clock()
+        req = Request(
+            req_id=next(self._req_ids),
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            stop_token=self.stop_token if stop_token == "default" else stop_token,
+            timeout_s=timeout_s,
+            submitted_at=now,
+            deadline=None if timeout_s is None else now + timeout_s,
+        )
+        try:
+            self.queue.submit(req)
+        except QueueFull:
+            self.metrics.record_reject()
+            raise
+        self.metrics.record_submit()
+        return req.req_id
+
+    def submit_with_retry(self, prompt, **kwargs) -> int:
+        """``submit`` with the parameter-client backoff idiom: retry a
+        ``QueueFull`` rejection over bounded increasing delays (honoring
+        the server's ``retry_after`` when it asks for longer), then give
+        up and let the rejection propagate."""
+        for delay in (*_RETRY_DELAYS, None):
+            try:
+                return self.submit(prompt, **kwargs)
+            except QueueFull as err:
+                if delay is None:
+                    raise
+                time.sleep(max(delay, err.retry_after))
+        raise AssertionError("unreachable")
+
+    def step(self) -> List[GenerationResult]:
+        """One scheduler iteration; publishes finished results."""
+        with self._step_lock:
+            finished = self.scheduler.step()
+        if finished:
+            with self._cond:
+                for r in finished:
+                    self._results[r.req_id] = r
+                self._cond.notify_all()
+        return finished
+
+    def result(
+        self, req_id: int, timeout_s: Optional[float] = None
+    ) -> GenerationResult:
+        """Block until ``req_id`` finishes. Without a serving thread this
+        drives the scheduler inline; alongside ``serve_forever`` it just
+        waits."""
+        deadline = None if timeout_s is None else self.clock() + timeout_s
+        while True:
+            with self._cond:
+                if req_id in self._results:
+                    return self._results.pop(req_id)
+            if self._step_lock.acquire(blocking=False):
+                # No server thread mid-step: advance the world ourselves.
+                try:
+                    finished = self.scheduler.step()
+                finally:
+                    self._step_lock.release()
+                if finished:
+                    with self._cond:
+                        for r in finished:
+                            self._results[r.req_id] = r
+                        self._cond.notify_all()
+                continue
+            with self._cond:
+                if req_id in self._results:
+                    return self._results.pop(req_id)
+                self._cond.wait(timeout=0.01)
+            if deadline is not None and self.clock() >= deadline:
+                raise TimeoutError(f"request {req_id} not done in {timeout_s}s")
+
+    def run_until_drained(self, max_steps: int = 100_000) -> None:
+        """Step until no queued or active work remains."""
+        for _ in range(max_steps):
+            if not self.scheduler.has_work:
+                return
+            self.step()
+        raise RuntimeError(f"not drained after {max_steps} steps")
+
+    def serve_forever(
+        self,
+        stop_event: Optional[threading.Event] = None,
+        idle_sleep_s: float = 0.001,
+    ) -> None:
+        """Serve until ``stop_event`` is set (forever if None). Run in a
+        thread; ``submit``/``result`` are safe from other threads."""
+        while stop_event is None or not stop_event.is_set():
+            if self.scheduler.has_work:
+                self.step()
+            else:
+                time.sleep(idle_sleep_s)
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            **self.metrics.summary(),
+            "prefill_traces": self._prefill_traces,
+            "decode_traces": self._decode_traces,
+            "pool_admitted_total": self.pool.admitted_total,
+            "pool_active": self.pool.active_count,
+            "pool_free": self.pool.free_count,
+        }

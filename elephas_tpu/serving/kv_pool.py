@@ -1,0 +1,126 @@
+"""Slot-based KV-cache pool for continuous batching.
+
+The pool owns ONE cache pytree of fixed shape — per layer,
+``cached_key``/``cached_value`` of (max_slots, heads, max_len, head_dim)
+plus per-slot ``cache_index``/``pos_index`` (max_slots,) vectors — so the
+compiled decode step's operand shapes never change as sequences come and
+go. Admission writes a finished prefill's batch-1 cache into a free
+slot's row (a jitted dynamic_update_slice with the slot id TRACED — one
+compile covers every slot); eviction just returns the slot id to the
+free list, since the next admit overwrites the row wholesale.
+
+Per-slot state the model consumes each step:
+
+- ``cache_index``/``pos_index`` — the column the slot's next token
+  writes (advanced by the apply itself, per row),
+- ``pad``        — the slot's left-pad column count (prompts are
+  left-padded to the engine's fixed prefill length so prefill is one
+  compiled program; the pad columns stay masked out of attention for
+  the sequence's whole lifetime).
+
+Inactive slots ride along in the decode batch (their logits are
+discarded and their rows rewritten on admit) — the price of a
+fixed-shape program, and exactly the slot semantics of continuous
+batching servers (Orca-style iteration-level scheduling).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _vectorize_indices(cache, max_slots: int):
+    """Replace every scalar cache index leaf with a per-slot vector."""
+
+    def fix(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("cache_index", "pos_index"):
+            assert leaf.ndim == 0, f"{name} already vectorized?"
+            return jnp.zeros((max_slots,), jnp.int32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@jax.jit
+def _write_slot(pool_cache, pad, prefill_cache, slot, pad_offset):
+    """Copy a batch-1 prefill cache into ``slot``'s row of the pool.
+
+    ``slot`` is a traced int32 — one compiled program admits to any
+    slot. Index leaves (pool (S,), prefill scalar) are distinguished
+    from data leaves (pool (S, ...), prefill (1, ...)) by rank.
+    """
+
+    def write(pool_leaf, pre_leaf):
+        if pre_leaf.ndim == 0:  # cache_index / pos_index
+            return jax.lax.dynamic_update_slice(
+                pool_leaf, pre_leaf[None].astype(pool_leaf.dtype), (slot,)
+            )
+        return jax.lax.dynamic_update_slice(
+            pool_leaf, pre_leaf.astype(pool_leaf.dtype),
+            (slot,) + (0,) * (pre_leaf.ndim - 1),
+        )
+
+    new_cache = jax.tree_util.tree_map(write, pool_cache, prefill_cache)
+    new_pad = jax.lax.dynamic_update_slice(pad, pad_offset[None], (slot,))
+    return new_cache, new_pad
+
+
+class KVCachePool:
+    """Fixed-shape KV cache + slot bookkeeping for the serving engine.
+
+    ``decode_module``: a ``TransformerLM`` with ``decode=True``.
+    ``max_slots``: decode batch width (concurrent sequences).
+    ``max_len``: cache columns per slot — an admitted sequence may run
+    to ``prefill_len + generated <= max_len``.
+    """
+
+    def __init__(self, decode_module, max_slots: int, max_len: int):
+        from elephas_tpu.models.transformer import make_decode_cache
+
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.cache = _vectorize_indices(
+            make_decode_cache(decode_module, max_slots, max_len), max_slots
+        )
+        self.pad = jnp.zeros((max_slots,), jnp.int32)
+        self._free: List[int] = list(range(max_slots))
+        self.admitted_total = 0  # lifetime admissions (slot reuse visible)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return self.max_slots - len(self._free)
+
+    def acquire(self) -> Optional[int]:
+        """Claim a free slot id, or None when the pool is saturated."""
+        if not self._free:
+            return None
+        return self._free.pop()
+
+    def admit(self, slot: int, prefill_cache, pad_offset: int) -> None:
+        """Write a finished batch-1 prefill into ``slot`` and record its
+        left-pad count. The prefill cache's scalar indices carry the
+        write position (= prefill length) into the slot's vectors."""
+        self.cache, self.pad = _write_slot(
+            self.cache, self.pad, prefill_cache, jnp.int32(slot),
+            jnp.int32(pad_offset),
+        )
+        self.admitted_total += 1
+
+    def release(self, slot: int) -> None:
+        """Return ``slot`` to the free list. No device work: the row's
+        stale contents are overwritten wholesale by the next admit."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free")
+        if not 0 <= slot < self.max_slots:
+            raise ValueError(f"slot {slot} out of range [0, {self.max_slots})")
+        self._free.append(slot)

@@ -1,0 +1,118 @@
+"""Serving observability: TTFT, inter-token latency, queue depth,
+tokens/sec — emitted through the existing ``metrics.logging.JsonlSink``
+(one JSON object per line, the same artifact format every committed
+benchmark in this repo uses) and aggregated in-memory for tests and the
+engine's ``stats()``.
+
+Two record streams share the sink, tagged by ``event``:
+
+- ``event="request"`` — one line per FINISHED request: status, prompt /
+  generated token counts, ``ttft_s`` (submit → first token),
+  ``itl_s_avg`` (mean gap between consecutive tokens), decode
+  tokens/sec for that request.
+- ``event="step"``   — one line per scheduler iteration (sampled every
+  ``step_log_every``): queue depth, active slots, tokens emitted this
+  step, step wall seconds.
+
+Metrics must degrade, not kill the serve loop — the sink already
+stringifies anything JSON can't carry; here a missing sink simply means
+in-memory aggregation only.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class ServingMetrics:
+    """Aggregator + JSONL emitter for the serving engine."""
+
+    def __init__(self, sink=None, step_log_every: int = 1,
+                 clock=time.monotonic):
+        self.sink = sink
+        self.step_log_every = max(1, int(step_log_every))
+        self.clock = clock
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.requests_timed_out = 0
+        self.requests_rejected = 0
+        self.tokens_out = 0
+        self.steps = 0
+        self.max_concurrent = 0
+        self.ttft_s: list = []
+        self.itl_s: list = []
+        self._t0: Optional[float] = None
+
+    # -- request lifecycle -------------------------------------------------
+
+    def record_submit(self) -> None:
+        if self._t0 is None:
+            self._t0 = self.clock()
+        self.requests_submitted += 1
+
+    def record_reject(self) -> None:
+        self.requests_rejected += 1
+
+    def record_finish(self, result, queue_depth: int, active: int) -> None:
+        if result.status == "timeout":
+            self.requests_timed_out += 1
+        else:
+            self.requests_completed += 1
+        self.tokens_out += len(result.tokens)
+        if result.ttft_s is not None:
+            self.ttft_s.append(result.ttft_s)
+        if result.itl_s_avg is not None:
+            self.itl_s.append(result.itl_s_avg)
+        if self.sink is not None:
+            self.sink.log(
+                self.steps,
+                event="request",
+                req_id=result.req_id,
+                status=result.status,
+                prompt_tokens=result.prompt_tokens,
+                new_tokens=len(result.tokens),
+                ttft_s=result.ttft_s,
+                itl_s_avg=result.itl_s_avg,
+                tokens_per_sec=result.tokens_per_sec,
+                queue_depth=queue_depth,
+                active_slots=active,
+            )
+
+    # -- scheduler cadence -------------------------------------------------
+
+    def record_step(self, queue_depth: int, active: int, tokens: int,
+                    step_seconds: float) -> None:
+        self.steps += 1
+        self.max_concurrent = max(self.max_concurrent, active)
+        if self.sink is not None and self.steps % self.step_log_every == 0:
+            self.sink.log(
+                self.steps,
+                event="step",
+                queue_depth=queue_depth,
+                active_slots=active,
+                step_tokens=tokens,
+                step_seconds=step_seconds,
+                tokens_per_sec=tokens / max(step_seconds, 1e-9),
+            )
+
+    # -- aggregates --------------------------------------------------------
+
+    def summary(self) -> dict:
+        elapsed = None if self._t0 is None else self.clock() - self._t0
+        mean = lambda xs: (sum(xs) / len(xs)) if xs else None  # noqa: E731
+        return {
+            "submitted": self.requests_submitted,
+            "completed": self.requests_completed,
+            "timed_out": self.requests_timed_out,
+            "rejected": self.requests_rejected,
+            "tokens_out": self.tokens_out,
+            "steps": self.steps,
+            "max_concurrent": self.max_concurrent,
+            "ttft_s_avg": mean(self.ttft_s),
+            "itl_s_avg": mean(self.itl_s),
+            "elapsed_s": elapsed,
+            "tokens_per_sec": (
+                self.tokens_out / elapsed if elapsed else None
+            ),
+        }

@@ -1,0 +1,294 @@
+"""Continuous-batching scheduler: iteration-level request scheduling
+over a fixed-shape KV-cache pool.
+
+The scheduling unit is one DECODE ITERATION, not one request (Orca-style
+continuous batching). Each ``step()``:
+
+1. evicts active sequences past their deadline (slot freed, partial
+   tokens returned with ``status="timeout"``),
+2. admits queued requests while free slots last — each admission runs a
+   batch-1 prefill at the engine's fixed prompt width and copies the
+   resulting cache into a pool slot, so a request joins the decode batch
+   MID-FLIGHT without touching the other sequences,
+3. runs ONE decode step over the whole pool (every slot, active or not
+   — fixed operand shapes keep it a single compiled program),
+4. harvests completions (stop token, token budget, cache capacity).
+
+Backpressure lives at the queue: a bounded ``RequestQueue`` whose
+``submit`` raises ``QueueFull`` carrying a ``retry_after`` hint —
+the same reject-then-backoff contract the parameter-server client
+implements on its side with ``_RETRY_DELAYS``.
+
+The scheduler is deliberately device-agnostic: it drives two injected
+callables (``prefill_fn``, ``decode_fn``) and a ``KVCachePool``, so
+tests can clock it with fakes and the engine owns the compiled closures.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+
+class QueueFull(RuntimeError):
+    """Admission control rejected a submit; retry after ``retry_after``s."""
+
+    def __init__(self, depth: int, limit: int, retry_after: float):
+        super().__init__(
+            f"request queue full ({depth}/{limit}); retry after "
+            f"{retry_after:.2f}s"
+        )
+        self.retry_after = retry_after
+
+
+@dataclass
+class Request:
+    """One generation request as it moves queue → slot → result."""
+
+    req_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    stop_token: Optional[int] = None
+    timeout_s: Optional[float] = None
+    submitted_at: float = 0.0
+    deadline: Optional[float] = None  # absolute, from submitted_at
+
+
+@dataclass
+class GenerationResult:
+    """Terminal state of a request. ``tokens`` excludes the prompt and,
+    for ``status="timeout"``, holds whatever was generated before
+    eviction (possibly empty)."""
+
+    req_id: int
+    tokens: List[int]
+    status: str  # "completed" | "timeout"
+    prompt_tokens: int
+    ttft_s: Optional[float] = None
+    itl_s_avg: Optional[float] = None
+    tokens_per_sec: Optional[float] = None
+
+
+class RequestQueue:
+    """Thread-safe bounded FIFO with reject-with-retry-after overflow.
+
+    ``retry_hint_s`` scales the hint by how oversubscribed the queue is:
+    a caller hitting a barely-full queue backs off less than one hitting
+    a deeply backed-up server.
+    """
+
+    def __init__(self, max_depth: int = 64, retry_hint_s: float = 0.1):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self.retry_hint_s = retry_hint_s
+        self._items: List[Request] = []
+        self._lock = threading.Lock()
+
+    def submit(self, request: Request) -> None:
+        with self._lock:
+            if len(self._items) >= self.max_depth:
+                raise QueueFull(
+                    len(self._items), self.max_depth,
+                    self.retry_hint_s * max(1, len(self._items) // 2),
+                )
+            self._items.append(request)
+
+    def pop(self) -> Optional[Request]:
+        with self._lock:
+            return self._items.pop(0) if self._items else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+@dataclass
+class _Active:
+    """Bookkeeping for a request occupying a pool slot."""
+
+    request: Request
+    slot: int
+    tokens: List[int]                    # generated so far (incl. first)
+    token_times: List[float]             # wall time per token, for ITL
+    budget: int                          # tokens still allowed (cache cap)
+
+
+class ContinuousBatchingScheduler:
+    """Drives prefill/decode interleaving over a ``KVCachePool``.
+
+    ``prefill_fn(prompt, pad_offset) -> (first_token, prefill_cache)``
+        batch-1 prefill at the fixed prompt width; ``prompt`` is the
+        left-padded (1, max_prompt_len) token array, ``pad_offset`` the
+        scalar pad-column count.
+    ``decode_fn(cache, tokens, pad) -> (next_tokens, new_cache)``
+        one decode step over all ``pool.max_slots`` rows; ``tokens`` is
+        the (max_slots,) vector of each slot's previous token.
+    """
+
+    def __init__(
+        self,
+        pool,
+        queue: RequestQueue,
+        prefill_fn: Callable,
+        decode_fn: Callable,
+        max_prompt_len: int,
+        pad_token: int = 0,
+        metrics=None,
+        clock=time.monotonic,
+    ):
+        self.pool = pool
+        self.queue = queue
+        self.prefill_fn = prefill_fn
+        self.decode_fn = decode_fn
+        self.max_prompt_len = max_prompt_len
+        self.pad_token = pad_token
+        self.metrics = metrics
+        self.clock = clock
+        self._active: Dict[int, _Active] = {}  # slot -> _Active
+        self._results: List[GenerationResult] = []
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._active) or len(self.queue) > 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _finish(self, entry: _Active, status: str) -> GenerationResult:
+        self.pool.release(entry.slot)
+        del self._active[entry.slot]
+        req = entry.request
+        times = entry.token_times
+        ttft = times[0] - req.submitted_at if times else None
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        itl = sum(gaps) / len(gaps) if gaps else None
+        span = times[-1] - req.submitted_at if times else None
+        result = GenerationResult(
+            req_id=req.req_id,
+            tokens=list(entry.tokens),
+            status=status,
+            prompt_tokens=len(req.prompt),
+            ttft_s=ttft,
+            itl_s_avg=itl,
+            tokens_per_sec=(
+                len(entry.tokens) / span if span and span > 0 else None
+            ),
+        )
+        self._results.append(result)
+        if self.metrics is not None:
+            self.metrics.record_finish(
+                result, queue_depth=len(self.queue), active=len(self._active)
+            )
+        return result
+
+    def _evict_expired(self) -> None:
+        now = self.clock()
+        for slot in [
+            s for s, e in self._active.items()
+            if e.request.deadline is not None and now >= e.request.deadline
+        ]:
+            self._finish(self._active[slot], "timeout")
+
+    def _admit_from_queue(self) -> None:
+        import jax.numpy as jnp
+
+        while self.pool.free_count > 0:
+            req = self.queue.pop()
+            if req is None:
+                return
+            # A request can expire while still queued — don't burn a
+            # prefill on it.
+            if req.deadline is not None and self.clock() >= req.deadline:
+                self._results.append(GenerationResult(
+                    req_id=req.req_id, tokens=[], status="timeout",
+                    prompt_tokens=len(req.prompt),
+                ))
+                if self.metrics is not None:
+                    self.metrics.record_finish(
+                        self._results[-1], queue_depth=len(self.queue),
+                        active=len(self._active),
+                    )
+                continue
+            plen = len(req.prompt)
+            pad = self.max_prompt_len - plen
+            padded = jnp.asarray(
+                [[self.pad_token] * pad + list(req.prompt)], jnp.int32
+            )
+            first, prefill_cache = self.prefill_fn(padded, jnp.int32(pad))
+            first = int(first)
+            slot = self.pool.acquire()
+            assert slot is not None  # guarded by free_count above
+            self.pool.admit(slot, prefill_cache, pad)
+            # Cache capacity bounds generation: prompt + generated tokens
+            # all live in max_len columns (pad columns included).
+            budget = min(
+                req.max_new_tokens, self.pool.max_len - self.max_prompt_len
+            )
+            entry = _Active(
+                request=req, slot=slot, tokens=[first],
+                token_times=[self.clock()], budget=budget,
+            )
+            self._active[slot] = entry
+            if first == req.stop_token or len(entry.tokens) >= budget:
+                self._finish(entry, "completed")
+
+    def _decode_step(self) -> int:
+        """One fixed-shape decode iteration; returns tokens emitted."""
+        import jax.numpy as jnp
+
+        if not self._active:
+            return 0
+        prev = [self.pad_token] * self.pool.max_slots
+        for slot, entry in self._active.items():
+            prev[slot] = entry.tokens[-1]
+        nxt, new_cache = self.decode_fn(
+            self.pool.cache, jnp.asarray(prev, jnp.int32), self.pool.pad
+        )
+        self.pool.cache = new_cache
+        nxt = [int(t) for t in nxt]
+        now = self.clock()
+        emitted = 0
+        for slot in list(self._active):
+            entry = self._active[slot]
+            tok = nxt[slot]
+            entry.tokens.append(tok)
+            entry.token_times.append(now)
+            emitted += 1
+            if tok == entry.request.stop_token or \
+                    len(entry.tokens) >= entry.budget:
+                self._finish(entry, "completed")
+        return emitted
+
+    def step(self) -> List[GenerationResult]:
+        """One scheduler iteration; returns requests finished during it."""
+        t0 = self.clock()
+        before = len(self._results)
+        self._evict_expired()
+        self._admit_from_queue()
+        emitted = self._decode_step()
+        if self.metrics is not None:
+            self.metrics.record_step(
+                queue_depth=len(self.queue), active=len(self._active),
+                tokens=emitted, step_seconds=self.clock() - t0,
+            )
+        return self._results[before:]
+
+    def run_until_drained(self, max_steps: int = 100_000) -> None:
+        """Step until queue and pool are empty (tests / batch draining)."""
+        for _ in range(max_steps):
+            if not self.has_work:
+                return
+            self.step()
+        raise RuntimeError(f"not drained after {max_steps} steps")
+
+    def drain_results(self) -> List[GenerationResult]:
+        out, self._results = self._results, []
+        return out

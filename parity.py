@@ -45,6 +45,44 @@ class EpochTimer:
         self.times.append(time.perf_counter())
 
 
+def micro_control() -> float:
+    """Pinned micro-workload measuring THIS session's effective machine
+    speed, run once at harness start: single device, synthetic
+    fixed-seed data, fixed shapes — nothing a code change under test
+    touches. Its steady samples/sec lands in every emitted row as
+    ``control_samples_per_sec``, so rows from different sessions compare
+    via ``ratio_to_control`` instead of raw rates (PARITY.md round 5:
+    same-code throughput moved 10–45% day-to-day with the dev tunnel,
+    which silently eats cross-session comparisons).
+    """
+    import jax
+    from elephas_tpu import compile_model
+    from elephas_tpu.data.rdd import ShardedDataset
+    from elephas_tpu.engine.sync import SyncTrainer
+    from elephas_tpu.models import get_model
+    from elephas_tpu.parallel.mesh import build_mesh
+
+    rng = np.random.default_rng(0)  # pinned: identical tensors every run
+    n, dim = 4096, 784
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+    net = compile_model(
+        get_model("mlp", features=(128, 128), num_classes=10),
+        optimizer={"name": "adam", "learning_rate": 1e-3},
+        loss="categorical_crossentropy",
+        metrics=["acc"],
+        input_shape=(dim,),
+    )
+    mesh = build_mesh(num_data=1, devices=[jax.devices()[0]])
+    trainer = SyncTrainer(net, mesh, frequency="epoch")
+    data = ShardedDataset(x, y, 1)
+    trainer.fit(data, epochs=1, batch_size=64)  # compile + warm-up
+    epochs = 3
+    t0 = time.perf_counter()
+    trainer.fit(data, epochs=epochs, batch_size=64)
+    return n * epochs / (time.perf_counter() - t0)
+
+
 def _record(name, mode, history, n_rows, epochs, secs, real, timer=None, extra=None):
     val_keys = [k for k in history if k.startswith("val_") and "acc" in k]
     acc_keys = [k for k in history if "acc" in k and not k.startswith("val_")]
@@ -374,9 +412,15 @@ def main():
     if unknown:
         raise SystemExit(f"unknown configs: {sorted(unknown)}; known: {sorted(CONFIGS)}")
 
+    control = round(micro_control(), 2)
+    print(json.dumps({"control_samples_per_sec": control}), flush=True)
+
     records = []
     for name in names:
         rec = CONFIGS[name](args.quick)
+        rec["control_samples_per_sec"] = control
+        if rec.get("samples_per_sec"):
+            rec["ratio_to_control"] = round(rec["samples_per_sec"] / control, 4)
         records.append(rec)
         print(json.dumps(rec), flush=True)
     with open(args.out, "a") as f:

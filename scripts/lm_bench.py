@@ -1,0 +1,211 @@
+"""LM decode throughput bench: KV-cache generate vs no-cache re-forward,
+plus the serving engine end-to-end (continuous batching over the slot
+pool).
+
+Emits one JSON object per measurement so the numbers land as a committed
+artifact (``--out BENCH_DECODE.json``):
+
+- ``{"mode": "cache" | "no_cache", "batch": B, ...}`` — tokens/sec of
+  batch-B greedy decode, with ``mfu`` when the chip's peak FLOPs are
+  known (None on CPU — see ``metrics.flops.peak_flops``),
+- ``{"mode": "serving", ...}`` — the ``InferenceEngine`` driven over a
+  mixed-length workload with mid-decode admission; reports engine
+  tokens/sec, TTFT, prefill/decode compile counts.
+
+Importable (and runnable with tiny defaults) without a TPU — tier-1
+collects it; real numbers come from the dev chip.
+
+Usage: python scripts/lm_bench.py [--batches 1 8 32] [--new 64]
+       [--out BENCH_DECODE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(vocab: int, d_model: int, heads: int, layers: int,
+                max_seq: int):
+    import jax.numpy as jnp
+
+    from elephas_tpu.api.compile import CompiledModel
+    from elephas_tpu.models.transformer import TransformerLM
+
+    module = TransformerLM(
+        vocab_size=vocab, d_model=d_model, num_heads=heads,
+        num_layers=layers, max_seq_len=max_seq,
+    )
+    return CompiledModel(
+        module,
+        optimizer="adam",
+        loss="sparse_categorical_crossentropy",
+        input_shape=(16,),
+        input_dtype=jnp.int32,
+    )
+
+
+def flops_per_decode_token(compiled, context_len: int) -> float:
+    from elephas_tpu.metrics import transformer_flops_per_token
+
+    m = compiled.module
+    return transformer_flops_per_token(
+        compiled.count_params(), m.num_layers, m.d_model, context_len
+    )
+
+
+def bench_generate(compiled, batch: int, prompt_len: int, new_tokens: int,
+                   use_cache: bool, reps: int) -> dict:
+    """Tokens/sec of batch-B greedy decode, cache vs no-cache."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from elephas_tpu.metrics import mfu
+    from elephas_tpu.models.transformer import generate
+
+    rng = np.random.default_rng(0)
+    vocab = compiled.module.vocab_size
+    prompt = rng.integers(1, vocab, (batch, prompt_len)).astype(np.int32)
+
+    if use_cache:
+        run = lambda: generate(compiled, prompt, new_tokens)  # noqa: E731
+    else:
+        # No-cache baseline: re-forward the growing sequence per token
+        # (the quadratic loop KV caching exists to remove).
+        fwd = jax.jit(
+            lambda params, toks: compiled.module.apply(
+                {"params": params}, toks
+            )
+        )
+
+        def run():
+            toks = jnp.asarray(prompt)
+            for _ in range(new_tokens):
+                logits = fwd(compiled.params, toks)
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+            return toks
+
+    jax.block_until_ready(run())  # compile outside the timed region
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = run()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    tps = batch * new_tokens / dt
+    fpt = flops_per_decode_token(compiled, prompt_len + new_tokens)
+    return {
+        "mode": "cache" if use_cache else "no_cache",
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "sec_per_rep": dt,
+        "tokens_per_sec": tps,
+        "mfu": mfu(tps, fpt),
+    }
+
+
+def bench_serving(compiled, max_slots: int, prompt_len: int,
+                  new_tokens: int, requests: int) -> dict:
+    """Drive the InferenceEngine over a mixed-length workload: more
+    requests than slots, staggered submits, so admission happens
+    mid-decode (continuous batching) and slots get reused."""
+    import numpy as np
+
+    from elephas_tpu.serving import InferenceEngine
+
+    rng = np.random.default_rng(1)
+    vocab = compiled.module.vocab_size
+    engine = InferenceEngine(
+        compiled,
+        max_slots=max_slots,
+        max_prompt_len=prompt_len,
+        max_len=prompt_len + new_tokens + 1,
+        queue_depth=max(requests, 1),
+    )
+    t0 = time.perf_counter()
+    rids = []
+    for i in range(requests):
+        plen = int(rng.integers(1, prompt_len + 1))
+        prompt = rng.integers(1, vocab, plen).tolist()
+        rids.append(engine.submit(prompt, max_new_tokens=new_tokens))
+        # Stagger: keep the pool busy while later requests arrive.
+        if len(rids) >= max_slots:
+            engine.step()
+    results = [engine.result(r) for r in rids]
+    dt = time.perf_counter() - t0
+    stats = engine.stats()
+    return {
+        "mode": "serving",
+        "max_slots": max_slots,
+        "requests": requests,
+        "completed": stats["completed"],
+        "tokens_out": stats["tokens_out"],
+        "wall_sec": dt,
+        "tokens_per_sec": stats["tokens_out"] / dt,
+        "ttft_s_avg": stats["ttft_s_avg"],
+        "itl_s_avg": stats["itl_s_avg"],
+        "prefill_traces": stats["prefill_traces"],
+        "decode_traces": stats["decode_traces"],
+        "pool_admitted_total": stats["pool_admitted_total"],
+        "all_completed": all(r.status == "completed" for r in results),
+    }
+
+
+def main(argv=None) -> list:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--batches", type=int, nargs="+", default=[1, 8, 32])
+    parser.add_argument("--prompt-len", type=int, default=32)
+    parser.add_argument("--new", type=int, default=64)
+    parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument("--vocab", type=int, default=512)
+    parser.add_argument("--d-model", type=int, default=128)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--layers", type=int, default=4)
+    parser.add_argument("--serving-slots", type=int, default=4)
+    parser.add_argument("--serving-requests", type=int, default=12)
+    parser.add_argument("--out", type=str, default=None,
+                        help="also write records as a JSON array")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    compiled = build_model(
+        args.vocab, args.d_model, args.heads, args.layers,
+        max_seq=args.prompt_len + args.new + 1,
+    )
+    records = [{
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "params": compiled.count_params(),
+        "d_model": args.d_model,
+        "layers": args.layers,
+    }]
+    for batch in args.batches:
+        for use_cache in (True, False):
+            rec = bench_generate(
+                compiled, batch, args.prompt_len, args.new, use_cache,
+                args.reps,
+            )
+            records.append(rec)
+            print(json.dumps(rec))
+    rec = bench_serving(
+        compiled, args.serving_slots, args.prompt_len, args.new,
+        args.serving_requests,
+    )
+    records.append(rec)
+    print(json.dumps(rec))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(records, f, indent=1)
+    return records
+
+
+if __name__ == "__main__":
+    main()

@@ -294,7 +294,52 @@ else:
         state, metrics = step(state, x, t)
         losses.append(float(metrics["loss"]))
     assert int(state.step) == 5
-print("RESULT " + json.dumps({"proc": idx, "losses": losses}))
+
+# Cross-rank params digest: per-leaf, hash addressable shards DEDUPED by
+# global index and sorted — rank-invariant for replicated layouts (both
+# ranks hold every shard) and for model/seq-sharded ones (both ranks hold
+# the same global indices of their data-replica), so equality across
+# ranks means the optimizer left identical weights everywhere.
+import hashlib
+from jax.tree_util import keystr, tree_flatten_with_path
+
+def _params_digest(params):
+    h = hashlib.sha256()
+    for path, leaf in sorted(
+        tree_flatten_with_path(params)[0], key=lambda kv: keystr(kv[0])
+    ):
+        h.update(keystr(path).encode())
+        shards = {}
+        for s in leaf.addressable_shards:
+            shards.setdefault(
+                str(s.index),
+                hashlib.sha256(
+                    np.ascontiguousarray(jax.device_get(s.data)).tobytes()
+                ).hexdigest(),
+            )
+        for idx_str in sorted(shards):
+            h.update(idx_str.encode())
+            h.update(shards[idx_str].encode())
+    return h.hexdigest()
+
+params_digest = _params_digest(state.params)
+pred_digest = None
+if kind == "trainer":
+    # Post-fit inference parity: host-local predict from each rank's own
+    # copy of the trained params must agree bitwise across ranks.
+    full = jax.tree_util.tree_map(
+        lambda a: np.asarray(a.addressable_data(0)), state.params
+    )
+    logits = compiled.module.apply(
+        {"params": full}, jnp.asarray(corpus[:4, :seq])
+    )
+    pred_digest = hashlib.sha256(
+        np.asarray(logits, np.float32).tobytes()
+    ).hexdigest()
+print("RESULT " + json.dumps({
+    "proc": idx, "losses": losses,
+    "params_digest": params_digest, "pred_digest": pred_digest,
+}))
 """
 
 
@@ -345,6 +390,14 @@ def test_two_process_seq_and_tensor_parallel(tmp_path, kind):
     losses = results[0]["losses"]
     assert all(np.isfinite(v) for v in losses)
     assert losses[-1] < losses[0]
+    # Identical losses can mask diverged weights (the loss is a single
+    # reduced scalar); the per-shard digest pins the PARAMETERS themselves
+    # bitwise-identical across ranks, for replicated and sharded layouts.
+    assert results[0]["params_digest"] == results[1]["params_digest"]
+    if kind == "trainer":
+        # And trained-model predictions from each rank's local copy agree.
+        assert results[0]["pred_digest"] == results[1]["pred_digest"]
+        assert results[0]["pred_digest"] is not None
 
 
 _HYPERPARAM_CHILD = """

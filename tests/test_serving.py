@@ -1,0 +1,350 @@
+"""Continuous-batching serving subsystem (``elephas_tpu.serving``) and
+the ragged/EOS generate path it builds on.
+
+The contract under test, end to end: arbitrary request traffic —
+mixed prompt lengths, mid-decode arrivals, deadlines, overload — is
+served by exactly TWO compiled programs (one prefill, one decode), and
+every served sequence is token-identical to decoding it alone.
+"""
+
+import json
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.metrics import (
+    JsonlSink,
+    mfu,
+    peak_flops,
+    transformer_flops_per_token,
+)
+from elephas_tpu.models import get_model
+from elephas_tpu.models.transformer import generate, generate_trace_count
+from elephas_tpu.serving import InferenceEngine, KVCachePool, QueueFull
+
+VOCAB, SEQ = 97, 64
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return CompiledModel(
+        get_model(
+            "transformer_lm", vocab_size=VOCAB, d_model=32, num_heads=4,
+            num_layers=2, max_seq_len=SEQ,
+        ),
+        optimizer={"name": "adam", "learning_rate": 3e-3},
+        loss="sparse_categorical_crossentropy",
+        metrics=[],
+        input_shape=(SEQ,),
+        input_dtype=jnp.int32,
+        seed=0,
+    )
+
+
+def _engine(compiled, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("max_len", 24)
+    kw.setdefault("queue_depth", 8)
+    return InferenceEngine(compiled, **kw)
+
+
+def _per_row(compiled, prompt, new_tokens, **kw):
+    out = generate(
+        compiled, np.asarray([prompt], np.int32), new_tokens, **kw
+    )
+    return [int(t) for t in out[0][len(prompt):]]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- ragged prefill + EOS in generate() (the enabling change) --------------
+
+
+def test_ragged_generate_matches_per_row(compiled):
+    """A ragged batch decodes token-identically to each row alone:
+    left-padding is masked out of attention and positions count from
+    each row's first real token."""
+    rows = [[5, 3, 9], [7, 2, 8, 4, 1, 6], [11, 12], [1, 2, 3, 4]]
+    out = generate(compiled, rows, 8)
+    plen = max(len(r) for r in rows)
+    assert out.shape == (4, plen + 8)
+    for i, row in enumerate(rows):
+        got = [int(t) for t in out[i][plen:]]
+        assert got == _per_row(compiled, row, 8), f"row {i} diverged"
+
+
+def test_ragged_generate_is_one_program(compiled):
+    """Different ragged length mixes at the same padded shape reuse one
+    compiled program — no per-length-combination retraces."""
+    before = generate_trace_count()
+    generate(compiled, [[5, 3, 9], [7, 2, 8, 4, 1, 6]], 4)
+    first = generate_trace_count() - before
+    assert first == 1
+    generate(compiled, [[1, 2, 3, 4, 5, 6], [9]], 4)  # same padded shape
+    assert generate_trace_count() - before == 1
+
+
+def test_generate_stop_token_freezes_rows(compiled):
+    """A row that emits ``stop_token`` keeps emitting it (frozen), and
+    its pre-stop tokens match the unstopped run."""
+    rows = [[5, 3, 9], [7, 2, 8, 4]]
+    free = generate(compiled, rows, 10)
+    plen = max(len(r) for r in rows)
+    # Pick an actually-emitted token as EOS so at least one row stops.
+    stop = int(free[0][plen + 2])
+    out = generate(compiled, rows, 10, stop_token=stop)
+    for i in range(len(rows)):
+        row = [int(t) for t in out[i][plen:]]
+        ref = [int(t) for t in free[i][plen:]]
+        if stop in ref:
+            k = ref.index(stop)
+            assert row[:k + 1] == ref[:k + 1]
+            assert all(t == stop for t in row[k:]), "row kept advancing past EOS"
+        else:
+            assert row == ref
+
+
+# -- KV-cache pool ---------------------------------------------------------
+
+
+def test_pool_acquire_release_cycle(compiled):
+    import dataclasses
+
+    module = dataclasses.replace(
+        compiled.module, decode=True, attention="dense"
+    )
+    pool = KVCachePool(module, max_slots=2, max_len=16)
+    a, b = pool.acquire(), pool.acquire()
+    assert {a, b} == {0, 1} and pool.acquire() is None
+    assert pool.free_count == 0 and pool.active_count == 2
+    pool.release(a)
+    assert pool.free_count == 1
+    with pytest.raises(ValueError):
+        pool.release(a)  # double free
+    assert pool.acquire() == a  # slot id recycled
+
+
+# -- engine: correctness under continuous batching -------------------------
+
+
+def test_engine_matches_per_row_decodes(compiled):
+    """Slot-pool serving is token-identical to single-row generate, and
+    the whole workload compiles exactly one prefill + one decode."""
+    eng = _engine(compiled)
+    prompts = [[5, 3, 9], [7, 2, 8, 4, 1, 6], [11, 12]]
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    for rid, p in zip(rids, prompts):
+        res = eng.result(rid, timeout_s=120)
+        assert res.status == "completed"
+        assert res.tokens == _per_row(compiled, p, 6)
+        assert res.ttft_s is not None and res.tokens_per_sec is not None
+    stats = eng.stats()
+    assert stats["prefill_traces"] == 1
+    assert stats["decode_traces"] == 1
+
+
+def test_engine_mid_decode_admission(compiled):
+    """A request admitted while another is mid-decode joins the batch
+    without perturbing it — both still match per-row decodes."""
+    eng = _engine(compiled, max_slots=2)
+    r1 = eng.submit([5, 3, 9], max_new_tokens=10)
+    for _ in range(3):
+        eng.step()  # r1 is now several tokens into decode
+    r2 = eng.submit([7, 2, 8, 4], max_new_tokens=10)
+    res1 = eng.result(r1, timeout_s=120)
+    res2 = eng.result(r2, timeout_s=120)
+    assert res1.tokens == _per_row(compiled, [5, 3, 9], 10)
+    assert res2.tokens == _per_row(compiled, [7, 2, 8, 4], 10)
+    assert eng.metrics.max_concurrent == 2  # they really overlapped
+    assert eng.stats()["decode_traces"] == 1  # admission didn't retrace
+
+
+def test_engine_stop_token_completes_early(compiled):
+    """EOS ends a served request early with the same tokens generate()
+    produces under the same stop."""
+    free = _per_row(compiled, [5, 3, 9], 10)
+    stop = free[3]
+    eng = _engine(compiled, stop_token=stop)
+    res = eng.result(eng.submit([5, 3, 9], max_new_tokens=10), timeout_s=120)
+    assert res.status == "completed"
+    assert res.tokens == free[:4]  # up to and including EOS, then stopped
+    assert eng.pool.free_count == eng.pool.max_slots  # slot came back
+
+
+def test_engine_slot_reuse_after_eviction(compiled):
+    """More requests than slots: completions free slots, later requests
+    reuse them, everyone still decodes correctly."""
+    eng = _engine(compiled, max_slots=2, queue_depth=8)
+    prompts = [[i + 1, i + 2] for i in range(5)]
+    rids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.run_until_drained()
+    for rid, p in zip(rids, prompts):
+        assert eng.result(rid, timeout_s=10).tokens == _per_row(compiled, p, 4)
+    assert eng.pool.admitted_total == 5  # 5 admissions through 2 slots
+    assert eng.pool.free_count == 2
+
+
+# -- admission control / deadlines -----------------------------------------
+
+
+def test_queue_full_backpressure(compiled, monkeypatch):
+    """Overload rejects with a retry_after hint; draining reopens
+    admission; submit_with_retry gives up after bounded backoff."""
+    eng = _engine(compiled, max_slots=1, queue_depth=2)
+    eng.submit([1, 2], max_new_tokens=2)
+    eng.submit([3, 4], max_new_tokens=2)
+    with pytest.raises(QueueFull) as exc:
+        eng.submit([5, 6], max_new_tokens=2)
+    assert exc.value.retry_after > 0
+    assert eng.stats()["rejected"] == 1
+
+    from elephas_tpu.serving import engine as engine_mod
+
+    monkeypatch.setattr(engine_mod, "_RETRY_DELAYS", (0.0, 0.0))
+    with pytest.raises(QueueFull):
+        eng.submit_with_retry([5, 6], max_new_tokens=2)  # nobody drains
+
+    eng.run_until_drained()
+    assert eng.submit([5, 6], max_new_tokens=2) >= 0  # admission reopened
+    eng.run_until_drained()
+
+
+def test_deadline_eviction_frees_slot(compiled):
+    """A request past its deadline is evicted mid-decode: partial tokens
+    come back as status='timeout' and the slot frees for the next
+    request."""
+    clock = FakeClock()
+    eng = _engine(compiled, max_slots=1, clock=clock)
+    rid = eng.submit([5, 3, 9], max_new_tokens=1000, timeout_s=5.0)
+    for _ in range(3):
+        clock.advance(1.0)
+        eng.step()
+    clock.advance(10.0)  # past the deadline
+    eng.step()
+    res = eng.result(rid, timeout_s=10)
+    assert res.status == "timeout"
+    assert 0 < len(res.tokens) < 1000  # partial output, not a full run
+    assert eng.pool.free_count == 1  # slot reclaimed
+    # The freed slot serves the next request normally.
+    res2 = eng.result(eng.submit([7, 2], max_new_tokens=3), timeout_s=10)
+    assert res2.status == "completed"
+    assert res2.tokens == _per_row(compiled, [7, 2], 3)
+
+
+def test_deadline_expires_in_queue(compiled):
+    """A request that times out before ever being admitted is returned
+    as timeout with no tokens — no prefill wasted on it."""
+    clock = FakeClock()
+    eng = _engine(compiled, max_slots=1, clock=clock)
+    busy = eng.submit([1, 2], max_new_tokens=50)
+    doomed = eng.submit([3, 4], max_new_tokens=5, timeout_s=2.0)
+    for _ in range(5):
+        clock.advance(1.0)
+        eng.step()
+    res = eng.result(doomed, timeout_s=10)
+    assert res.status == "timeout" and res.tokens == []
+    assert eng.result(busy, timeout_s=120).status == "completed"
+
+
+# -- threaded frontend -----------------------------------------------------
+
+
+def test_serve_forever_thread(compiled):
+    """submit/result from the caller thread while serve_forever drives
+    the scheduler in another."""
+    eng = _engine(compiled)
+    stop = threading.Event()
+    t = threading.Thread(target=eng.serve_forever, args=(stop,), daemon=True)
+    t.start()
+    try:
+        prompts = [[5, 3, 9], [7, 2, 8, 4], [11, 12]]
+        rids = [eng.submit_with_retry(p, max_new_tokens=5) for p in prompts]
+        for rid, p in zip(rids, prompts):
+            res = eng.result(rid, timeout_s=120)
+            assert res.status == "completed"
+            assert res.tokens == _per_row(compiled, p, 5)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not t.is_alive()
+
+
+# -- metrics ---------------------------------------------------------------
+
+
+def test_jsonl_sink_records(compiled, tmp_path):
+    """Request and step records land in the JsonlSink with the serving
+    fields (TTFT, ITL, queue depth, tokens/sec)."""
+    path = str(tmp_path / "serving.jsonl")
+    with JsonlSink(path) as sink:
+        eng = _engine(compiled, sink=sink)
+        eng.result(eng.submit([5, 3, 9], max_new_tokens=4), timeout_s=120)
+        eng.result(eng.submit([7, 2], max_new_tokens=4), timeout_s=120)
+    records = [json.loads(l) for l in open(path)]
+    reqs = [r for r in records if r["event"] == "request"]
+    steps = [r for r in records if r["event"] == "step"]
+    assert len(reqs) == 2 and steps
+    for r in reqs:
+        assert r["status"] == "completed"
+        assert r["ttft_s"] > 0 and r["tokens_per_sec"] > 0
+        assert r["new_tokens"] == 4
+    assert all("queue_depth" in s and "active_slots" in s for s in steps)
+    summary = eng.metrics.summary()
+    assert summary["completed"] == 2 and summary["tokens_out"] == 8
+
+
+def test_mfu_helpers():
+    small = transformer_flops_per_token(1_000_000, 4, 128, 64)
+    large = transformer_flops_per_token(1_000_000, 4, 128, 4096)
+    assert 0 < small < large  # attention term grows with context
+    bwd = transformer_flops_per_token(1_000_000, 4, 128, 64, backward=True)
+    assert bwd == pytest.approx(3 * small)
+    assert mfu(1000.0, 1e9, peak=1e13) == pytest.approx(1e-1)
+    assert peak_flops("TPU v4 chip") == pytest.approx(275e12)
+    assert peak_flops("cpu") is None  # unknown chip -> no MFU claim
+    assert mfu(1000.0, 1e9, peak=None) is None or True  # CPU path: no crash
+
+
+# -- bench script ----------------------------------------------------------
+
+
+def test_lm_bench_importable():
+    """The bench must import (and parse args) without a TPU attached."""
+    import scripts.lm_bench as lm_bench
+
+    assert callable(lm_bench.main)
+    rec = lm_bench.flops_per_decode_token.__doc__ or ""  # importable API
+    assert hasattr(lm_bench, "bench_serving") and rec is not None
+
+
+@pytest.mark.slow
+def test_lm_bench_tiny_run(tmp_path):
+    """End-to-end bench run at toy sizes: cache/no-cache/serving records
+    all emitted, serving arm completes its workload."""
+    import scripts.lm_bench as lm_bench
+
+    out = tmp_path / "bench.json"
+    records = lm_bench.main([
+        "--batches", "1", "2", "--prompt-len", "8", "--new", "8",
+        "--reps", "1", "--vocab", "64", "--d-model", "32", "--heads", "4",
+        "--layers", "2", "--serving-slots", "2", "--serving-requests", "5",
+        "--out", str(out),
+    ])
+    modes = [r.get("mode") for r in records]
+    assert modes.count("cache") == 2 and modes.count("no_cache") == 2
+    serving = [r for r in records if r.get("mode") == "serving"][0]
+    assert serving["all_completed"] and serving["prefill_traces"] == 1
+    assert json.load(open(out))  # committed-artifact path works
