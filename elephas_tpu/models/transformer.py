@@ -50,7 +50,7 @@ class SelfAttention(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, pad_offset=None):
+    def __call__(self, x, pad_offset=None, active=None):
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
         qkv = nn.DenseGeneral((3, self.num_heads, head_dim), dtype=self.dtype,
@@ -60,7 +60,7 @@ class SelfAttention(nn.Module):
         k = jnp.transpose(k, (0, 2, 1, 3))
         v = jnp.transpose(v, (0, 2, 1, 3))
         if self.decode:
-            return self._decode_attend(x, q, k, v, d_model, pad_offset)
+            return self._decode_attend(x, q, k, v, d_model, pad_offset, active)
         attention = self.attention
         if attention == "auto" and not self.is_initializing():
             # Resolved at trace time (axis size is static): sequence-
@@ -109,7 +109,8 @@ class SelfAttention(nn.Module):
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(x.shape[0], x.shape[1], d_model)
         return nn.DenseGeneral(d_model, dtype=self.dtype, name="out")(out)
 
-    def _decode_attend(self, x, q, k, v, d_model, pad_offset=None):
+    def _decode_attend(self, x, q, k, v, d_model, pad_offset=None,
+                       active=None):
         """Incremental (KV-cache) attention for autoregressive sampling.
 
         The cache is SHAPED on the init pass (which feeds a full-length
@@ -122,8 +123,13 @@ class SelfAttention(nn.Module):
         the batch) or a (batch,) vector of independent per-row columns
         (the serving KV pool, where each slot is mid-decode at its own
         depth). ``pad_offset`` (batch,) masks each row's leading
-        left-pad columns out of attention. Training never touches this
-        path — it exists for ``generate`` and ``serving``."""
+        left-pad columns out of attention. ``active`` (batch,) bool —
+        serving only — freezes INACTIVE rows' ``cache_index``: free pool
+        slots ride along in the fixed-shape decode batch for the whole
+        pool lifetime, and without the freeze their index vectors march
+        past ``max_len`` while nothing is admitted. Training never
+        touches this path — it exists for ``generate`` and
+        ``serving``."""
         b, h, seq, head_dim = q.shape
         init_pass = not self.has_variable("cache", "cached_key")
         cached_key = self.variable(
@@ -164,7 +170,16 @@ class SelfAttention(nn.Module):
                 cv = row_update(cached_value.value, v.astype(self.dtype), idx)
             cached_key.value = ck
             cached_value.value = cv
-            cache_index.value = idx + seq
+            if active is not None:
+                if idx.ndim == 0:
+                    raise ValueError(
+                        "active masks require per-row (batch,) cache "
+                        "indices (the serving pool layout); generate()'s "
+                        "scalar index path never passes active"
+                    )
+                cache_index.value = jnp.where(active, idx + seq, idx)
+            else:
+                cache_index.value = idx + seq
             max_len = ck.shape[2]
             scale = 1.0 / np.sqrt(head_dim)
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck) * scale
@@ -196,12 +211,13 @@ class Block(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, pad_offset=None):
+    def __call__(self, x, pad_offset=None, active=None):
         d_model = x.shape[-1]
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         x = x + SelfAttention(self.num_heads, dtype=self.dtype,
                               attention=self.attention,
-                              decode=self.decode)(y, pad_offset=pad_offset)
+                              decode=self.decode)(y, pad_offset=pad_offset,
+                                                  active=active)
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         h = nn.Dense(d_model * self.mlp_ratio, dtype=self.dtype)(y)
         h = nn.gelu(h)
@@ -219,7 +235,8 @@ class TransformerLM(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False, pad_offset=None):
+    def __call__(self, tokens, train: bool = False, pad_offset=None,
+                 active=None):
         seq = tokens.shape[1]
         x = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(
             tokens.astype(jnp.int32)
@@ -230,11 +247,12 @@ class TransformerLM(nn.Module):
             (self.max_seq_len, self.d_model),
         )
         if self.decode:
-            return self._decode_forward(tokens, x, pos, seq, pad_offset)
-        if pad_offset is not None:
+            return self._decode_forward(tokens, x, pos, seq, pad_offset,
+                                        active)
+        if pad_offset is not None or active is not None:
             raise ValueError(
-                "pad_offset (ragged left-padded batches) is only supported "
-                "on the decode=True path"
+                "pad_offset / active (ragged left-padded serving batches) "
+                "are only supported on the decode=True path"
             )
         from elephas_tpu.parallel.ring_attention import (
             require_seq_axis,
@@ -263,7 +281,8 @@ class TransformerLM(nn.Module):
         # Next-token logits, tied head kept separate for simplicity.
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
 
-    def _decode_forward(self, tokens, x, pos, seq, pad_offset=None):
+    def _decode_forward(self, tokens, x, pos, seq, pad_offset=None,
+                        active=None):
         """Incremental forward for sampling: positional embedding from a
         module-level position counter (advanced by each apply's block
         length — the batched prompt prefill, then one token per sampling
@@ -285,7 +304,12 @@ class TransformerLM(nn.Module):
             x = (x + pos[:seq]).astype(self.dtype)
         else:
             idx = pos_index.value
-            pos_index.value = idx + seq
+            if active is not None:
+                # Serving pool: free slots' position counters freeze in
+                # lockstep with their frozen layer cache_index vectors.
+                pos_index.value = jnp.where(active, idx + seq, idx)
+            else:
+                pos_index.value = idx + seq
             if idx.ndim == 0 and pad_offset is None:
                 x = (
                     x + jax.lax.dynamic_slice_in_dim(pos, idx, seq, axis=0)
@@ -300,7 +324,7 @@ class TransformerLM(nn.Module):
                 x = (x + jnp.take(pos, cols, axis=0)).astype(self.dtype)
         for _ in range(self.num_layers):
             x = Block(self.num_heads, dtype=self.dtype, attention="dense",
-                      decode=True)(x, pad_offset=pad_offset)
+                      decode=True)(x, pad_offset=pad_offset, active=active)
         x = nn.LayerNorm(dtype=jnp.float32)(x.astype(jnp.float32))
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
 

@@ -114,6 +114,29 @@ def lm_param_specs(params, rules: Optional[Rules] = None) -> Dict:
     return param_specs(params, rules)
 
 
+def decode_cache_specs(cache, axis: str = MODEL_AXIS) -> Dict:
+    """PartitionSpec pytree for a ``TransformerLM`` decode cache (the
+    serving KV pool, or a batch-1 prefill cache).
+
+    K/V leaves — ``cached_key``/``cached_value``, laid out
+    ``(batch|slots, heads, len, head_dim)`` — shard over their HEADS
+    axis, matching the qkv kernel's head sharding in ``LM_RULES`` so the
+    decode attention runs fully local per device and GSPMD only inserts
+    the output projection's psum. Index leaves (``cache_index`` /
+    ``pos_index``, scalar or per-slot vectors) replicate: every device
+    advances every slot's write position identically.
+    """
+
+    def spec(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("cached_key", "cached_value"):
+            assert leaf.ndim == 4, f"{name}: expected rank-4, got {leaf.shape}"
+            return P(None, axis, None, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, cache)
+
+
 def keras_param_rules(keras_model, rules: Rules) -> Rules:
     """Translate rules over Keras variable paths into bridge-key rules.
 

@@ -13,13 +13,29 @@ decode step into an engine that serves request traffic:
                          interleaving, deadline eviction, backpressure
                          (``serving.scheduler``),
 - ``InferenceEngine``  — the frontend: ``submit()`` / ``result()`` /
-                         ``serve_forever()`` (``serving.engine``),
+                         ``serve_forever()``; ``shard_serving()`` makes
+                         the two compiled programs tensor-parallel over
+                         a mesh's ``'model'`` axis (``serving.engine``),
 - ``ServingMetrics``   — TTFT / inter-token latency / queue depth /
-                         tokens-per-sec through ``metrics.JsonlSink``
-                         (``serving.metrics``).
+                         tokens-per-sec / dispatch→fetch device overlap
+                         through ``metrics.JsonlSink``
+                         (``serving.metrics``),
+- ``host_sync``        — the ONE sanctioned device→host sync point;
+                         ``scripts/lint_blocking.py`` statically bans
+                         blocking reads anywhere else in this package.
+
+The decode hot path is PIPELINED (one-step lookahead: dispatch N+1
+before reading N's tokens) and DONATION-CLEAN (the pool cache is donated
+to every program that rewrites it; ``DonatedBufferError`` guards stale
+reads). Both are engine-internal: token streams are identical to the
+unpipelined path (``pipeline=False``).
 """
 
-from elephas_tpu.serving.kv_pool import KVCachePool  # noqa: F401
+from elephas_tpu.serving import host_sync  # noqa: F401
+from elephas_tpu.serving.kv_pool import (  # noqa: F401
+    DonatedBufferError,
+    KVCachePool,
+)
 from elephas_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     GenerationResult,
@@ -27,5 +43,8 @@ from elephas_tpu.serving.scheduler import (  # noqa: F401
     Request,
     RequestQueue,
 )
-from elephas_tpu.serving.engine import InferenceEngine  # noqa: F401
+from elephas_tpu.serving.engine import (  # noqa: F401
+    InferenceEngine,
+    shard_serving,
+)
 from elephas_tpu.serving.metrics import ServingMetrics  # noqa: F401

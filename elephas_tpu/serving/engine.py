@@ -7,17 +7,36 @@ compiled programs that together serve arbitrary request traffic:
 - ``prefill``: batch-1, fixed ``max_prompt_len`` width (prompts are
   left-padded into it), emits the first token and the prompt's KV cache;
 - ``decode``: one token for every pool slot per call, fixed
-  ``(max_slots,)`` shapes, per-slot cache positions.
+  ``(max_slots,)`` shapes, per-slot cache positions. The KV-cache
+  operand is DONATED (``donate_argnums``), so XLA rewrites the pool in
+  place instead of copying every layer's K/V each token, and the
+  previous step's device token vector chains straight back in as the
+  next step's input (one-step-lookahead pipelining — see
+  ``serving.scheduler``). Freshly admitted lanes are spliced in with a
+  ``where`` override INSIDE the program; free lanes are masked so their
+  cache index vectors freeze.
 
 Admission, eviction, slot reuse and backpressure all happen HOST-side
 between calls — neither program ever retraces once warm, which is the
 entire point of the fixed-shape pool (``_prefill_traces`` /
-``_decode_traces`` count compilations; tests pin them to 1).
+``_decode_traces`` count compilations; tests pin them to 1). The only
+blocking device→host reads go through ``serving.host_sync``
+(``scripts/lint_blocking.py`` enforces this statically).
+
+Tensor-parallel serving (``shard_serving``): before the first request,
+annotate the parameters with the Megatron ``LM_RULES`` ``NamedSharding``s
+and every KV-pool leaf with a head-axis sharding, then re-jit both
+programs with ``in_shardings``/``out_shardings`` — GSPMD lowers the same
+two programs across the mesh's ``'model'`` axis and inserts the
+collectives itself. No ``shard_map``, so it runs on any backend that can
+host a mesh (including ``--xla_force_host_platform_device_count``
+virtual CPUs).
 
 Usage::
 
     engine = InferenceEngine(compiled, max_slots=4, max_prompt_len=16,
                              max_len=64, stop_token=eos)
+    engine.shard_serving(build_mesh(num_data=1, num_model=4))  # optional
     rid = engine.submit([5, 3, 9], max_new_tokens=20)
     result = engine.result(rid)          # drives steps inline, or waits
     ...                                  # on a serve_forever thread
@@ -72,6 +91,9 @@ class InferenceEngine:
     queue_depth: admission-control bound on queued (unadmitted) requests.
     temperature/top_k: 0/0 = greedy (default); otherwise sampled with an
         engine-owned PRNG stream.
+    pipeline: one-step-lookahead decode (default). ``False`` selects the
+        unpipelined oracle path — token-identical, device idles during
+        host bookkeeping; exists for A/B tests and benchmarks.
     sink: optional ``metrics.JsonlSink`` for request/step records.
     """
 
@@ -89,6 +111,7 @@ class InferenceEngine:
         temperature: float = 0.0,
         top_k: int = 0,
         seed: int = 0,
+        pipeline: bool = True,
         sink=None,
         clock=time.monotonic,
     ):
@@ -133,17 +156,37 @@ class InferenceEngine:
             pad_token=pad_token,
             metrics=self.metrics,
             clock=clock,
+            pipeline=pipeline,
         )
 
         self._prefill_traces = 0
         self._decode_traces = 0
-        self._jit_prefill = jax.jit(self._prefill_impl)
-        self._jit_decode = jax.jit(self._decode_impl)
+        self.mesh = None  # set by shard_serving
+        self._make_jits()
 
         self._req_ids = itertools.count()
         self._results: Dict[int, GenerationResult] = {}
         self._cond = threading.Condition()
         self._step_lock = threading.Lock()
+
+    def _make_jits(self, in_shardings=None, out_shardings=None):
+        """(Re)build the two compiled entry points. With shardings the
+        same two programs lower via GSPMD over the mesh — still exactly
+        one prefill and one decode compile."""
+        pre_in = pre_out = dec_in = dec_out = None
+        if in_shardings is not None:
+            pre_in, dec_in = in_shardings
+            pre_out, dec_out = out_shardings
+        self._jit_prefill = jax.jit(
+            self._prefill_impl, in_shardings=pre_in, out_shardings=pre_out
+        )
+        # The pool cache (argnum 1) is donated: decode rewrites it in
+        # place; the stale reference dies at dispatch (KVCachePool's
+        # guard turns any later read into a loud error).
+        self._jit_decode = jax.jit(
+            self._decode_impl, donate_argnums=(1,),
+            in_shardings=dec_in, out_shardings=dec_out,
+        )
 
     # -- compiled bodies ---------------------------------------------------
 
@@ -169,14 +212,20 @@ class InferenceEngine:
         )
         return first[0], mutated["cache"]
 
-    def _decode_impl(self, params, cache, tokens, pad, rng):
+    def _decode_impl(self, params, cache, prev_tokens, override_vals,
+                     override_mask, active_mask, pad, rng):
         self._decode_traces += 1
         from elephas_tpu.models.transformer import sample_tokens
 
+        # Freshly-admitted lanes get their prefill first token here,
+        # INSIDE the one compiled program — the pipelined scheduler
+        # never materializes the token vector host-side.
+        tokens = jnp.where(override_mask, override_vals, prev_tokens)
         logits, mutated = self.decode_module.apply(
             {"params": params, "cache": cache},
             tokens[:, None],
             pad_offset=pad,
+            active=active_mask,
             mutable=["cache"],
         )
         nxt = sample_tokens(
@@ -196,11 +245,88 @@ class InferenceEngine:
         )
         return first, cache
 
-    def _decode(self, cache, tokens, pad):
+    def _decode(self, cache, prev_tokens, override_vals, override_mask,
+                active_mask, pad):
         nxt, new_cache = self._jit_decode(
-            self.params, cache, tokens, pad, self._next_rng()
+            self.params, cache, prev_tokens, override_vals, override_mask,
+            active_mask, pad, self._next_rng(),
         )
         return nxt, new_cache
+
+    # -- tensor-parallel serving -------------------------------------------
+
+    def shard_serving(self, mesh, rules=None):
+        """Make both compiled programs tensor-parallel over ``mesh``'s
+        ``'model'`` axis (GSPMD: annotate, don't rewrite).
+
+        Parameters get the Megatron ``NamedSharding``s from
+        ``tensor_parallel.param_specs`` (``rules`` defaults to
+        ``LM_RULES``); every KV-pool K/V leaf is sharded over its heads
+        axis (index vectors and pad replicated); prefill/decode are
+        re-jit with explicit ``in_shardings``/``out_shardings`` so both
+        programs lower sharded. Must be called BEFORE the first request
+        — re-jitting warm programs would break the one-compile-each
+        invariant, so a warm engine is refused.
+
+        Returns ``self`` (builder style).
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from elephas_tpu.models.transformer import make_decode_cache
+        from elephas_tpu.parallel.mesh import MODEL_AXIS
+        from elephas_tpu.parallel.tensor_parallel import (
+            decode_cache_specs,
+            param_specs,
+        )
+
+        if self._prefill_traces or self._decode_traces or \
+                self.pool.admitted_total:
+            raise RuntimeError(
+                "shard_serving must run before the first request: the "
+                "engine's programs are already compiled/warm, and "
+                "re-jitting them would break the exactly-one-compile "
+                "invariant"
+            )
+        tp = mesh.shape.get(MODEL_AXIS, 1)
+        heads = self.decode_module.num_heads
+        if heads % tp != 0:
+            raise ValueError(
+                f"num_heads ({heads}) must divide evenly over the "
+                f"'{MODEL_AXIS}' mesh axis ({tp}) to shard the KV pool"
+            )
+
+        def named(spec_tree):
+            return jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), spec_tree,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
+        repl = NamedSharding(mesh, P())
+        p_sh = named(param_specs(self.params, rules))
+        pool_sh = named(decode_cache_specs(self.pool.cache))
+        prefill_cache = make_decode_cache(self.decode_module, 1,
+                                          self.pool.max_len)
+        prefill_sh = named(decode_cache_specs(prefill_cache))
+
+        # Place params and the (still-empty) pool on the mesh, then
+        # re-jit so both programs lower via GSPMD with these layouts.
+        self.params = jax.device_put(self.params, p_sh)
+        self.pool.swap(
+            jax.device_put(self.pool.cache, pool_sh),
+            jax.device_put(self.pool.pad, repl),
+        )
+        self._make_jits(
+            in_shardings=(
+                (p_sh, repl, repl, repl),                      # prefill
+                (p_sh, pool_sh) + (repl,) * 6,                 # decode
+            ),
+            out_shardings=(
+                (repl, prefill_sh),                            # prefill
+                (repl, pool_sh),                               # decode
+            ),
+        )
+        self.mesh = mesh
+        return self
 
     # -- frontend ----------------------------------------------------------
 
@@ -213,7 +339,7 @@ class InferenceEngine:
     ) -> int:
         """Enqueue a request; returns its id. Raises ``QueueFull`` (with
         ``.retry_after``) when admission control rejects it."""
-        prompt = [int(t) for t in prompt]
+        prompt = [int(t) for t in prompt]  # host-ok: caller-supplied ints
         if not 1 <= len(prompt) <= self.max_prompt_len:
             raise ValueError(
                 f"prompt length {len(prompt)} outside [1, "
@@ -326,3 +452,9 @@ class InferenceEngine:
             "pool_active": self.pool.active_count,
             "pool_free": self.pool.free_count,
         }
+
+
+def shard_serving(engine: InferenceEngine, mesh, rules=None) -> InferenceEngine:
+    """Module-level alias for ``InferenceEngine.shard_serving`` (the
+    ROADMAP's tensor-parallel-decode entry point)."""
+    return engine.shard_serving(mesh, rules=rules)

@@ -9,10 +9,22 @@ slot's row (a jitted dynamic_update_slice with the slot id TRACED — one
 compile covers every slot); eviction just returns the slot id to the
 free list, since the next admit overwrites the row wholesale.
 
+The cache pytree is DONATED to every program that rewrites it — the
+admission ``_write_slot`` here and the engine's decode step — so XLA
+updates the pool in place instead of materializing a full copy of every
+layer's K/V each token (the copy was PR 1's single biggest per-step
+cost after the host sync). Donation makes the OLD buffers poison: any
+read through a stale reference raises, so ``self._cache`` is private
+and the ``cache`` property guards every access with an explicit
+use-after-donate check (a stale read would otherwise surface as an
+opaque ``Array has been deleted`` deep inside XLA).
+
 Per-slot state the model consumes each step:
 
 - ``cache_index``/``pos_index`` — the column the slot's next token
-  writes (advanced by the apply itself, per row),
+  writes (advanced by the apply itself, per row — ONLY for rows the
+  decode step's ``active`` mask marks occupied; free slots' vectors
+  freeze so they can't march past ``max_len`` between admissions),
 - ``pad``        — the slot's left-pad column count (prompts are
   left-padded to the engine's fixed prefill length so prefill is one
   compiled program; the pad columns stay masked out of attention for
@@ -26,6 +38,7 @@ batching servers (Orca-style iteration-level scheduling).
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional
 
 import jax
@@ -45,13 +58,15 @@ def _vectorize_indices(cache, max_slots: int):
     return jax.tree_util.tree_map_with_path(fix, cache)
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0, 1))
 def _write_slot(pool_cache, pad, prefill_cache, slot, pad_offset):
     """Copy a batch-1 prefill cache into ``slot``'s row of the pool.
 
     ``slot`` is a traced int32 — one compiled program admits to any
     slot. Index leaves (pool (S,), prefill scalar) are distinguished
-    from data leaves (pool (S, ...), prefill (1, ...)) by rank.
+    from data leaves (pool (S, ...), prefill (1, ...)) by rank. The
+    pool cache and pad vector are DONATED: XLA writes the slot row in
+    place, so admission costs one row, not a whole-pool copy.
     """
 
     def write(pool_leaf, pre_leaf):
@@ -69,6 +84,10 @@ def _write_slot(pool_cache, pad, prefill_cache, slot, pad_offset):
     return new_cache, new_pad
 
 
+class DonatedBufferError(RuntimeError):
+    """A pool cache reference was read after its buffers were donated."""
+
+
 class KVCachePool:
     """Fixed-shape KV cache + slot bookkeeping for the serving engine.
 
@@ -76,6 +95,12 @@ class KVCachePool:
     ``max_slots``: decode batch width (concurrent sequences).
     ``max_len``: cache columns per slot — an admitted sequence may run
     to ``prefill_len + generated <= max_len``.
+
+    The live cache is read through the ``cache`` property and replaced
+    with ``swap(new_cache)`` after every donating program. The property
+    refuses to hand out donated (deleted) buffers — the failure mode
+    donation introduces is a stale alias kept across a swap, and that
+    must fail loudly at the POOL boundary, not as a deep XLA error.
     """
 
     def __init__(self, decode_module, max_slots: int, max_len: int):
@@ -85,12 +110,48 @@ class KVCachePool:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.max_slots = max_slots
         self.max_len = max_len
-        self.cache = _vectorize_indices(
+        self._cache = _vectorize_indices(
             make_decode_cache(decode_module, max_slots, max_len), max_slots
         )
-        self.pad = jnp.zeros((max_slots,), jnp.int32)
+        self._pad = jnp.zeros((max_slots,), jnp.int32)
         self._free: List[int] = list(range(max_slots))
         self.admitted_total = 0  # lifetime admissions (slot reuse visible)
+
+    # -- donation-guarded cache access -------------------------------------
+
+    @staticmethod
+    def _guard(tree, name: str):
+        # One leaf suffices: every leaf of a donated pytree is deleted
+        # by the same program call.
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        if getattr(leaf, "is_deleted", lambda: False)():
+            raise DonatedBufferError(
+                f"KV pool {name} was donated to a compiled program and "
+                "its buffers are gone; use the value returned by that "
+                "program (the engine swaps it back via pool.swap)"
+            )
+        return tree
+
+    @property
+    def cache(self):
+        """The live cache pytree (raises ``DonatedBufferError`` if the
+        held buffers were donated without a ``swap``)."""
+        return self._guard(self._cache, "cache")
+
+    @property
+    def pad(self):
+        """Per-slot left-pad counts, same donation guard as ``cache``."""
+        return self._guard(self._pad, "pad")
+
+    def swap(self, new_cache, new_pad=None) -> None:
+        """Install the cache (and optionally pad) a donating program
+        returned. The old references are dead the moment the program was
+        dispatched — this is the only legal way to keep the pool live."""
+        self._cache = new_cache
+        if new_pad is not None:
+            self._pad = new_pad
+
+    # -- slot bookkeeping --------------------------------------------------
 
     @property
     def free_count(self) -> int:
@@ -99,6 +160,11 @@ class KVCachePool:
     @property
     def active_count(self) -> int:
         return self.max_slots - len(self._free)
+
+    def active_slots(self) -> List[int]:
+        """Occupied slot ids, ascending (the decode step's active mask)."""
+        free = set(self._free)
+        return [s for s in range(self.max_slots) if s not in free]
 
     def acquire(self) -> Optional[int]:
         """Claim a free slot id, or None when the pool is saturated."""
@@ -110,10 +176,10 @@ class KVCachePool:
         """Write a finished batch-1 prefill into ``slot`` and record its
         left-pad count. The prefill cache's scalar indices carry the
         write position (= prefill length) into the slot's vectors."""
-        self.cache, self.pad = _write_slot(
+        self.swap(*_write_slot(
             self.cache, self.pad, prefill_cache, jnp.int32(slot),
             jnp.int32(pad_offset),
-        )
+        ))
         self.admitted_total += 1
 
     def release(self, slot: int) -> None:

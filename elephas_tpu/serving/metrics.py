@@ -12,7 +12,12 @@ Two record streams share the sink, tagged by ``event``:
   tokens/sec for that request.
 - ``event="step"``   — one line per scheduler iteration (sampled every
   ``step_log_every``): queue depth, active slots, tokens emitted this
-  step, step wall seconds.
+  step, step wall seconds, and ``dispatch_to_fetch_s`` — the
+  device-overlap gauge: wall seconds between a decode step's dispatch
+  and the harvest of its tokens. On the pipelined path all host
+  bookkeeping for the previous step happens inside this window, so the
+  gauge reads ≈ one full step of hidden host work; on the unpipelined
+  path it collapses to the bare device-compute+transfer time.
 
 Metrics must degrade, not kill the serve loop — the sink already
 stringifies anything JSON can't carry; here a missing sink simply means
@@ -31,7 +36,7 @@ class ServingMetrics:
     def __init__(self, sink=None, step_log_every: int = 1,
                  clock=time.monotonic):
         self.sink = sink
-        self.step_log_every = max(1, int(step_log_every))
+        self.step_log_every = max(1, int(step_log_every))  # host-ok: arg
         self.clock = clock
         self.requests_submitted = 0
         self.requests_completed = 0
@@ -42,7 +47,27 @@ class ServingMetrics:
         self.max_concurrent = 0
         self.ttft_s: list = []
         self.itl_s: list = []
+        self.dispatch_to_fetch_s: list = []
+        self._last_overlap: Optional[float] = None
         self._t0: Optional[float] = None
+
+    def reset(self) -> None:
+        """Zero every in-memory aggregate (the sink, if any, keeps its
+        already-written lines). Benchmarks warm the compile caches with
+        a throwaway request, then reset so the timed run's numbers
+        measure serving, not XLA compilation."""
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.requests_timed_out = 0
+        self.requests_rejected = 0
+        self.tokens_out = 0
+        self.steps = 0
+        self.max_concurrent = 0
+        self.ttft_s = []
+        self.itl_s = []
+        self.dispatch_to_fetch_s = []
+        self._last_overlap = None
+        self._t0 = None
 
     # -- request lifecycle -------------------------------------------------
 
@@ -81,10 +106,17 @@ class ServingMetrics:
 
     # -- scheduler cadence -------------------------------------------------
 
+    def record_overlap(self, seconds: float) -> None:
+        """Dispatch→fetch wall time for one decode step (the window the
+        pipelined scheduler hides host bookkeeping in)."""
+        self.dispatch_to_fetch_s.append(seconds)
+        self._last_overlap = seconds
+
     def record_step(self, queue_depth: int, active: int, tokens: int,
                     step_seconds: float) -> None:
         self.steps += 1
         self.max_concurrent = max(self.max_concurrent, active)
+        overlap, self._last_overlap = self._last_overlap, None
         if self.sink is not None and self.steps % self.step_log_every == 0:
             self.sink.log(
                 self.steps,
@@ -93,6 +125,7 @@ class ServingMetrics:
                 active_slots=active,
                 step_tokens=tokens,
                 step_seconds=step_seconds,
+                dispatch_to_fetch_s=overlap,
                 tokens_per_sec=tokens / max(step_seconds, 1e-9),
             )
 
@@ -111,6 +144,7 @@ class ServingMetrics:
             "max_concurrent": self.max_concurrent,
             "ttft_s_avg": mean(self.ttft_s),
             "itl_s_avg": mean(self.itl_s),
+            "dispatch_to_fetch_s_avg": mean(self.dispatch_to_fetch_s),
             "elapsed_s": elapsed,
             "tokens_per_sec": (
                 self.tokens_out / elapsed if elapsed else None

@@ -1,18 +1,31 @@
 """Continuous-batching scheduler: iteration-level request scheduling
-over a fixed-shape KV-cache pool.
+over a fixed-shape KV-cache pool, with a one-step-lookahead pipelined
+decode hot path.
 
 The scheduling unit is one DECODE ITERATION, not one request (Orca-style
-continuous batching). Each ``step()``:
+continuous batching). In the default PIPELINED mode each ``step()``:
 
-1. evicts active sequences past their deadline (slot freed, partial
-   tokens returned with ``status="timeout"``),
-2. admits queued requests while free slots last — each admission runs a
-   batch-1 prefill at the engine's fixed prompt width and copies the
-   resulting cache into a pool slot, so a request joins the decode batch
-   MID-FLIGHT without touching the other sequences,
-3. runs ONE decode step over the whole pool (every slot, active or not
-   — fixed operand shapes keep it a single compiled program),
-4. harvests completions (stop token, token budget, cache capacity).
+1. dispatches decode step N+1 *first*, chaining the device token vector
+   decode N produced straight back in as the next input — the host
+   never reads it before dispatch, so the device starts the next
+   iteration immediately,
+2. only then fetches step N's tokens (active lanes only, through the
+   one sanctioned sync point in ``serving.host_sync``) and does all the
+   host bookkeeping — stop-token checks, budget exhaustion, deadline
+   eviction, admission prefills, metrics — OVERLAPPED with step N+1's
+   device compute,
+3. admits queued requests while free slots last; an admitted request's
+   prefill-produced first token reaches the device as a per-lane
+   OVERRIDE on the next dispatch (a ``where`` folded into the one
+   compiled decode program, not a new program).
+
+Pipelining semantics: token streams are IDENTICAL to the unpipelined
+path (``pipeline=False``). The only observable differences are (a) a
+finished request's completion is detected one step after its final
+token is computed — one wasted lane-iteration — and (b) an admission
+joins the decode batch one step later. Deadline-evicted requests return
+exactly the same partial token list in both modes, because eviction
+runs AFTER the previous step's harvest.
 
 Backpressure lives at the queue: a bounded ``RequestQueue`` whose
 ``submit`` raises ``QueueFull`` carrying a ``retry_after`` hint —
@@ -29,7 +42,11 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elephas_tpu.serving import host_sync
 
 
 class QueueFull(RuntimeError):
@@ -116,16 +133,33 @@ class _Active:
     budget: int                          # tokens still allowed (cache cap)
 
 
+@dataclass
+class _Inflight:
+    """A dispatched-but-unread decode step (the lookahead window)."""
+
+    tokens: Any                          # (max_slots,) device token vector
+    lanes: List[Tuple[int, _Active]]     # entries occupying lanes at dispatch
+    dispatched_at: float = 0.0
+
+
 class ContinuousBatchingScheduler:
     """Drives prefill/decode interleaving over a ``KVCachePool``.
 
     ``prefill_fn(prompt, pad_offset) -> (first_token, prefill_cache)``
         batch-1 prefill at the fixed prompt width; ``prompt`` is the
         left-padded (1, max_prompt_len) token array, ``pad_offset`` the
-        scalar pad-column count.
-    ``decode_fn(cache, tokens, pad) -> (next_tokens, new_cache)``
-        one decode step over all ``pool.max_slots`` rows; ``tokens`` is
-        the (max_slots,) vector of each slot's previous token.
+        scalar pad-column count. ``first_token`` is a DEVICE scalar.
+    ``decode_fn(cache, prev_tokens, override_vals, override_mask,
+    active_mask, pad) -> (next_tokens, new_cache)``
+        one decode step over all ``pool.max_slots`` rows.
+        ``prev_tokens`` is the (max_slots,) vector of each lane's
+        previous token — on the pipelined path the DEVICE OUTPUT of the
+        previous call, chained without a host read. ``override_vals`` /
+        ``override_mask`` splice freshly-admitted lanes' first tokens in
+        (host (max_slots,) arrays); ``active_mask`` marks occupied lanes
+        whose cache index vectors may advance. The cache argument is
+        DONATED — callers must treat it as dead and use ``new_cache``
+        (the scheduler swaps it into the pool immediately).
     """
 
     def __init__(
@@ -138,6 +172,7 @@ class ContinuousBatchingScheduler:
         pad_token: int = 0,
         metrics=None,
         clock=time.monotonic,
+        pipeline: bool = True,
     ):
         self.pool = pool
         self.queue = queue
@@ -147,8 +182,13 @@ class ContinuousBatchingScheduler:
         self.pad_token = pad_token
         self.metrics = metrics
         self.clock = clock
+        self.pipeline = pipeline
         self._active: Dict[int, _Active] = {}  # slot -> _Active
         self._results: List[GenerationResult] = []
+        self._inflight: Optional[_Inflight] = None
+        # slot -> first token to splice into the NEXT dispatch (set by
+        # admissions that happened after the current inflight dispatch).
+        self._overrides: Dict[int, int] = {}
 
     # -- introspection -----------------------------------------------------
 
@@ -158,13 +198,18 @@ class ContinuousBatchingScheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._active) or len(self.queue) > 0
+        return (
+            bool(self._active)
+            or len(self.queue) > 0
+            or self._inflight is not None
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
     def _finish(self, entry: _Active, status: str) -> GenerationResult:
         self.pool.release(entry.slot)
         del self._active[entry.slot]
+        self._overrides.pop(entry.slot, None)
         req = entry.request
         times = entry.token_times
         ttft = times[0] - req.submitted_at if times else None
@@ -219,11 +264,13 @@ class ContinuousBatchingScheduler:
                 continue
             plen = len(req.prompt)
             pad = self.max_prompt_len - plen
-            padded = jnp.asarray(
+            padded = jnp.asarray(  # host-ok: host list → device upload
                 [[self.pad_token] * pad + list(req.prompt)], jnp.int32
             )
-            first, prefill_cache = self.prefill_fn(padded, jnp.int32(pad))
-            first = int(first)
+            first_dev, prefill_cache = self.prefill_fn(padded, jnp.int32(pad))
+            # The admission-path sync: on the pipelined path this overlaps
+            # the in-flight decode step dispatched before bookkeeping.
+            first = host_sync.fetch_scalar(first_dev)
             slot = self.pool.acquire()
             assert slot is not None  # guarded by free_count above
             self.pool.admit(slot, prefill_cache, pad)
@@ -239,41 +286,113 @@ class ContinuousBatchingScheduler:
             self._active[slot] = entry
             if first == req.stop_token or len(entry.tokens) >= budget:
                 self._finish(entry, "completed")
+            else:
+                self._overrides[slot] = first
 
-    def _decode_step(self) -> int:
-        """One fixed-shape decode iteration; returns tokens emitted."""
-        import jax.numpy as jnp
+    # -- the decode hot path -----------------------------------------------
 
-        if not self._active:
-            return 0
-        prev = [self.pad_token] * self.pool.max_slots
+    def _dispatch(self, prev_tokens) -> _Inflight:
+        """Launch one decode iteration (non-blocking) and swap the
+        donated cache. ``prev_tokens`` is the previous step's device
+        output or a host-built vector when no step is in flight."""
+        S = self.pool.max_slots
+        override_vals = np.full((S,), self.pad_token, np.int32)
+        override_mask = np.zeros((S,), bool)
+        for slot, tok in self._overrides.items():
+            override_vals[slot] = tok
+            override_mask[slot] = True
+        self._overrides.clear()
+        active_mask = np.zeros((S,), bool)
+        lanes = sorted(self._active.items())
+        for slot, _ in lanes:
+            active_mask[slot] = True
+        nxt, new_cache = self.decode_fn(
+            self.pool.cache, prev_tokens, override_vals, override_mask,
+            active_mask, self.pool.pad,
+        )
+        self.pool.swap(new_cache)
+        return _Inflight(tokens=nxt, lanes=lanes,
+                         dispatched_at=self.clock())
+
+    def _host_prev_tokens(self):
+        """Previous-token vector built host-side — the cold-start path
+        (nothing in flight to chain from). Admission overrides are
+        already reflected in each entry's ``tokens[-1]``."""
+        prev = np.full((self.pool.max_slots,), self.pad_token, np.int32)
         for slot, entry in self._active.items():
             prev[slot] = entry.tokens[-1]
-        nxt, new_cache = self.decode_fn(
-            self.pool.cache, jnp.asarray(prev, jnp.int32), self.pool.pad
+        self._overrides.clear()
+        return prev
+
+    def _harvest(self, inflight: _Inflight) -> int:
+        """Read a dispatched step's tokens back (active lanes only) and
+        run the host bookkeeping: append, stop/budget checks, finishes.
+        Lanes whose entry finished or was evicted AFTER dispatch are
+        skipped — their computed token is the one wasted lane-iteration
+        pipelining costs on stop detection."""
+        live = [
+            (slot, entry) for slot, entry in inflight.lanes
+            if self._active.get(slot) is entry
+        ]
+        if not live:
+            return 0
+        fetched = host_sync.fetch_lanes(
+            inflight.tokens, [slot for slot, _ in live]
         )
-        self.pool.cache = new_cache
-        nxt = [int(t) for t in nxt]
         now = self.clock()
+        if self.metrics is not None:
+            self.metrics.record_overlap(now - inflight.dispatched_at)
         emitted = 0
-        for slot in list(self._active):
-            entry = self._active[slot]
-            tok = nxt[slot]
+        for (slot, entry), (_, tok) in zip(live, fetched):
             entry.tokens.append(tok)
             entry.token_times.append(now)
             emitted += 1
             if tok == entry.request.stop_token or \
                     len(entry.tokens) >= entry.budget:
                 self._finish(entry, "completed")
+            else:
+                # The lane's next input rides the device chain; a stale
+                # override from a previous occupancy must not clobber it.
+                self._overrides.pop(slot, None)
         return emitted
+
+    def _step_pipelined(self) -> int:
+        """Dispatch N+1, then do ALL host work overlapped with it."""
+        prev = self._inflight
+        self._inflight = None
+        if self._active:
+            self._inflight = self._dispatch(
+                prev.tokens if prev is not None else self._host_prev_tokens()
+            )
+        emitted = self._harvest(prev) if prev is not None else 0
+        # Host bookkeeping below overlaps the just-dispatched step.
+        self._evict_expired()
+        self._admit_from_queue()
+        if self._inflight is None and self._active:
+            # Cold start: the pool was empty at the top of the step and
+            # admissions just filled it — dispatch now rather than
+            # wasting a whole iteration before the first decode.
+            self._inflight = self._dispatch(self._host_prev_tokens())
+        return emitted
+
+    def _step_sync(self) -> int:
+        """The unpipelined reference path: evict, admit, decode, read —
+        the device idles during every host phase. Kept as the oracle the
+        pipelined path is tested token-identical against."""
+        self._evict_expired()
+        self._admit_from_queue()
+        if not self._active:
+            return 0
+        inflight = self._dispatch(self._host_prev_tokens())
+        return self._harvest(inflight)
 
     def step(self) -> List[GenerationResult]:
         """One scheduler iteration; returns requests finished during it."""
         t0 = self.clock()
         before = len(self._results)
-        self._evict_expired()
-        self._admit_from_queue()
-        emitted = self._decode_step()
+        emitted = (
+            self._step_pipelined() if self.pipeline else self._step_sync()
+        )
         if self.metrics is not None:
             self.metrics.record_step(
                 queue_depth=len(self.queue), active=len(self._active),

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Static check: no blocking device→host syncs in the serving hot path.
+
+The pipelined scheduler's whole value is that the device never waits on
+Python — decode step N+1 is dispatched before step N's tokens are read,
+and the ONLY place a device value may cross to the host is
+``elephas_tpu/serving/host_sync.py``. A single stray ``int(device_val)``
+anywhere else silently serializes every step and erases the overlap, so
+this lint walks the serving package's ASTs and rejects every
+host-conversion call outside the sanctioned module:
+
+- ``int(...)`` / ``float(...)``        (implicit blocking scalar fetch)
+- ``.item()`` / ``.tolist()``          (explicit blocking conversions)
+- ``np.asarray(...)`` / ``np.array(...)`` (numpy coercion of a possibly
+  device array — host upload belongs to ``jnp.asarray``)
+- ``jax.device_get(...)``              (the raw transfer primitive)
+- ``.block_until_ready()`` / ``jax.block_until_ready(...)``
+
+Escape hatch: a line whose source carries a ``# host-ok`` pragma is
+exempt — for conversions of values that PROVABLY never touched the
+device (caller-supplied python ints, numpy buffers already fetched
+through ``host_sync``). The pragma keeps every exemption greppable.
+
+Wired into tier-1 via ``tests/test_lint_blocking.py``; also runnable
+standalone: ``python scripts/lint_blocking.py`` (exit 1 on violations).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, NamedTuple
+
+PRAGMA = "host-ok"
+SANCTIONED = "host_sync.py"
+_NUMPY_NAMES = ("np", "numpy")
+
+
+class Violation(NamedTuple):
+    path: str
+    lineno: int
+    call: str
+    line: str
+
+    def __str__(self):
+        return (
+            f"{self.path}:{self.lineno}: blocking host sync `{self.call}` "
+            f"outside host_sync.py (add `# {PRAGMA}` only if the value "
+            f"never touched the device)\n    {self.line.strip()}"
+        )
+
+
+def _call_name(node: ast.Call) -> str | None:
+    """The lint-relevant name of a call, or None if it's not watched."""
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in ("int", "float"):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in ("item", "tolist", "block_until_ready", "device_get"):
+            return f".{fn.attr}" if fn.attr != "device_get" else "device_get"
+        if fn.attr in ("asarray", "array") and isinstance(fn.value, ast.Name) \
+                and fn.value.id in _NUMPY_NAMES:
+            return f"{fn.value.id}.{fn.attr}"
+    return None
+
+
+def lint_file(path: Path) -> List[Violation]:
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name is None:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if PRAGMA in line:
+            continue
+        out.append(Violation(str(path), node.lineno, name, line))
+    return out
+
+
+def lint_package(root: Path) -> List[Violation]:
+    """Lint every module in the serving package except the sanctioned
+    sync point itself."""
+    out = []
+    for path in sorted(root.glob("*.py")):
+        if path.name == SANCTIONED:
+            continue
+        out.extend(lint_file(path))
+    return out
+
+
+def main(argv: List[str] | None = None) -> List[Violation]:
+    args = list(sys.argv[1:] if argv is None else argv)
+    root = Path(args[0]) if args else (
+        Path(__file__).resolve().parent.parent / "elephas_tpu" / "serving"
+    )
+    violations = lint_package(root)
+    for v in violations:
+        print(v)
+    if not violations:
+        print(f"lint_blocking: {root} clean")
+    return violations
+
+
+if __name__ == "__main__":
+    sys.exit(1 if main() else 0)

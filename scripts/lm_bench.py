@@ -6,11 +6,19 @@ Emits one JSON object per measurement so the numbers land as a committed
 artifact (``--out BENCH_DECODE.json``):
 
 - ``{"mode": "cache" | "no_cache", "batch": B, ...}`` — tokens/sec of
-  batch-B greedy decode, with ``mfu`` when the chip's peak FLOPs are
-  known (None on CPU — see ``metrics.flops.peak_flops``),
-- ``{"mode": "serving", ...}`` — the ``InferenceEngine`` driven over a
-  mixed-length workload with mid-decode admission; reports engine
-  tokens/sec, TTFT, prefill/decode compile counts.
+  batch-B greedy decode. EVERY row carries ``flops_per_token`` (from
+  ``metrics.flops.transformer_flops_per_token``) so the achieved-FLOPs
+  math is reproducible from the artifact alone, and ``mfu`` when the
+  chip's peak FLOPs are known (None on CPU — see
+  ``metrics.flops.peak_flops``),
+- ``{"mode": "serving", "pipeline": bool, ...}`` — the
+  ``InferenceEngine`` driven over a mixed-length workload with
+  mid-decode admission, one arm per scheduler mode (unpipelined
+  reference vs one-step-lookahead), so the artifact shows the
+  before/after of pipelining directly; reports engine tokens/sec, TTFT,
+  dispatch→fetch overlap, prefill/decode compile counts. The serving
+  arms also land in their own artifact via ``--serve-out
+  BENCH_SERVE.json``.
 
 Importable (and runnable with tiny defaults) without a TPU — tier-1
 collects it; real numbers come from the dev chip.
@@ -107,17 +115,22 @@ def bench_generate(compiled, batch: int, prompt_len: int, new_tokens: int,
         "new_tokens": new_tokens,
         "sec_per_rep": dt,
         "tokens_per_sec": tps,
+        "flops_per_token": fpt,
         "mfu": mfu(tps, fpt),
     }
 
 
 def bench_serving(compiled, max_slots: int, prompt_len: int,
-                  new_tokens: int, requests: int) -> dict:
+                  new_tokens: int, requests: int,
+                  pipeline: bool = True) -> dict:
     """Drive the InferenceEngine over a mixed-length workload: more
     requests than slots, staggered submits, so admission happens
-    mid-decode (continuous batching) and slots get reused."""
+    mid-decode (continuous batching) and slots get reused.
+    ``pipeline=False`` runs the unpipelined reference scheduler — the
+    before/after pair is the pipelining speedup."""
     import numpy as np
 
+    from elephas_tpu.metrics import mfu
     from elephas_tpu.serving import InferenceEngine
 
     rng = np.random.default_rng(1)
@@ -128,7 +141,14 @@ def bench_serving(compiled, max_slots: int, prompt_len: int,
         max_prompt_len=prompt_len,
         max_len=prompt_len + new_tokens + 1,
         queue_depth=max(requests, 1),
+        pipeline=pipeline,
     )
+    # Warm all three compiled programs (prefill, slot admission, decode)
+    # outside the timed region — bench_generate does the same with its
+    # untimed first run. Serving tok/s measures serving, not XLA
+    # compile time.
+    engine.result(engine.submit([1] * prompt_len, max_new_tokens=2))
+    engine.metrics.reset()
     t0 = time.perf_counter()
     rids = []
     for i in range(requests):
@@ -141,16 +161,22 @@ def bench_serving(compiled, max_slots: int, prompt_len: int,
     results = [engine.result(r) for r in rids]
     dt = time.perf_counter() - t0
     stats = engine.stats()
+    tps = stats["tokens_out"] / dt
+    fpt = flops_per_decode_token(compiled, prompt_len + new_tokens)
     return {
         "mode": "serving",
+        "pipeline": pipeline,
         "max_slots": max_slots,
         "requests": requests,
         "completed": stats["completed"],
         "tokens_out": stats["tokens_out"],
         "wall_sec": dt,
-        "tokens_per_sec": stats["tokens_out"] / dt,
+        "tokens_per_sec": tps,
+        "flops_per_token": fpt,
+        "mfu": mfu(tps, fpt),
         "ttft_s_avg": stats["ttft_s_avg"],
         "itl_s_avg": stats["itl_s_avg"],
+        "dispatch_to_fetch_s_avg": stats["dispatch_to_fetch_s_avg"],
         "prefill_traces": stats["prefill_traces"],
         "decode_traces": stats["decode_traces"],
         "pool_admitted_total": stats["pool_admitted_total"],
@@ -172,6 +198,9 @@ def main(argv=None) -> list:
     parser.add_argument("--serving-requests", type=int, default=12)
     parser.add_argument("--out", type=str, default=None,
                         help="also write records as a JSON array")
+    parser.add_argument("--serve-out", type=str, default=None,
+                        help="write the serving arms (before/after "
+                             "pipelining) as their own JSON artifact")
     args = parser.parse_args(argv)
 
     import jax
@@ -195,15 +224,21 @@ def main(argv=None) -> list:
             )
             records.append(rec)
             print(json.dumps(rec))
-    rec = bench_serving(
-        compiled, args.serving_slots, args.prompt_len, args.new,
-        args.serving_requests,
-    )
-    records.append(rec)
-    print(json.dumps(rec))
+    serving_records = []
+    for pipeline in (False, True):  # reference first, then the hot path
+        rec = bench_serving(
+            compiled, args.serving_slots, args.prompt_len, args.new,
+            args.serving_requests, pipeline=pipeline,
+        )
+        serving_records.append(rec)
+        records.append(rec)
+        print(json.dumps(rec))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(records, f, indent=1)
+    if args.serve_out:
+        with open(args.serve_out, "w") as f:
+            json.dump([records[0], *serving_records], f, indent=1)
     return records
 
 

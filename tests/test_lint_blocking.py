@@ -1,0 +1,68 @@
+"""Tier-1 wiring for ``scripts/lint_blocking.py``: the serving package
+must stay free of blocking device→host syncs outside ``host_sync.py``,
+and the lint itself must actually catch the conversions it claims to.
+"""
+
+import textwrap
+from pathlib import Path
+
+import scripts.lint_blocking as lint
+
+
+def test_serving_package_is_clean():
+    """THE invariant: every hot-path module passes; any new blocking
+    conversion in elephas_tpu/serving/ fails tier-1 here."""
+    root = Path(lint.__file__).resolve().parent.parent / \
+        "elephas_tpu" / "serving"
+    assert root.is_dir()
+    violations = lint.lint_package(root)
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_lint_catches_each_conversion(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import numpy as np
+        import jax
+
+        def f(x):
+            a = int(x[0])
+            b = float(x.sum())
+            c = x.item()
+            d = x.tolist()
+            e = np.asarray(x)
+            g = np.array(x)
+            h = jax.device_get(x)
+            jax.block_until_ready(x)
+            x.block_until_ready()
+            return a, b, c, d, e, g, h
+    """))
+    calls = {v.call for v in lint.lint_file(bad)}
+    assert calls == {
+        "int", "float", ".item", ".tolist", "np.asarray", "np.array",
+        "device_get", ".block_until_ready",
+    }
+
+
+def test_pragma_exempts_a_line(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "def f(xs):\n"
+        "    return [int(x) for x in xs]  # host-ok: caller ints\n"
+    )
+    assert lint.lint_file(ok) == []
+
+
+def test_host_sync_module_is_sanctioned(tmp_path):
+    pkg = tmp_path / "serving"
+    pkg.mkdir()
+    (pkg / "host_sync.py").write_text("import jax\nfetch = jax.device_get\n")
+    (pkg / "other.py").write_text("def f(x):\n    return int(x)\n")
+    violations = lint.lint_package(pkg)
+    assert len(violations) == 1
+    assert violations[0].path.endswith("other.py")
+
+
+def test_cli_reports_clean(capsys):
+    assert lint.main([]) == []
+    assert "clean" in capsys.readouterr().out

@@ -337,14 +337,20 @@ def test_lm_bench_tiny_run(tmp_path):
     import scripts.lm_bench as lm_bench
 
     out = tmp_path / "bench.json"
+    serve_out = tmp_path / "bench_serve.json"
     records = lm_bench.main([
         "--batches", "1", "2", "--prompt-len", "8", "--new", "8",
         "--reps", "1", "--vocab", "64", "--d-model", "32", "--heads", "4",
         "--layers", "2", "--serving-slots", "2", "--serving-requests", "5",
-        "--out", str(out),
+        "--out", str(out), "--serve-out", str(serve_out),
     ])
     modes = [r.get("mode") for r in records]
     assert modes.count("cache") == 2 and modes.count("no_cache") == 2
-    serving = [r for r in records if r.get("mode") == "serving"][0]
-    assert serving["all_completed"] and serving["prefill_traces"] == 1
+    assert all("flops_per_token" in r for r in records if "mode" in r)
+    serving = [r for r in records if r.get("mode") == "serving"]
+    assert [r["pipeline"] for r in serving] == [False, True]
+    for r in serving:
+        assert r["all_completed"] and r["prefill_traces"] == 1
+        assert r["decode_traces"] == 1
     assert json.load(open(out))  # committed-artifact path works
+    assert len(json.load(open(serve_out))) == 3  # header + both arms

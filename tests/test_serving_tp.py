@@ -1,0 +1,130 @@
+"""Tensor-parallel serving (``InferenceEngine.shard_serving``) on the
+8 forced host devices (tests/conftest.py sets
+``--xla_force_host_platform_device_count=8`` before jax imports).
+
+GSPMD smoke contract: after ``shard_serving`` the SAME two compiled
+programs serve the SAME token streams, with parameters laid out per
+``LM_RULES`` and every KV-pool K/V leaf sharded over its heads axis on
+the mesh's ``'model'`` axis — still exactly one prefill + one decode
+compile. Model dims are chosen divisible by the model-axis size
+(heads=4, d_model=32, vocab=64 over a 4-way model axis).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.models import get_model
+from elephas_tpu.parallel.mesh import MODEL_AXIS, build_mesh
+from elephas_tpu.parallel.tensor_parallel import decode_cache_specs
+from elephas_tpu.serving import InferenceEngine
+from tests.test_serving import _per_row
+
+VOCAB, SEQ = 64, 64
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return CompiledModel(
+        get_model(
+            "transformer_lm", vocab_size=VOCAB, d_model=32, num_heads=4,
+            num_layers=2, max_seq_len=SEQ,
+        ),
+        optimizer={"name": "adam", "learning_rate": 3e-3},
+        loss="sparse_categorical_crossentropy",
+        metrics=[],
+        input_shape=(SEQ,),
+        input_dtype=jnp.int32,
+        seed=0,
+    )
+
+
+def _tp_engine(compiled, mesh, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("max_len", 24)
+    eng = InferenceEngine(compiled, **kw)
+    if mesh is not None:
+        eng.shard_serving(mesh)
+    return eng
+
+
+def test_decode_cache_specs_shapes():
+    """K/V leaves head-sharded, index/pad leaves replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    cache = {
+        "layer": {
+            "attn": {
+                "cached_key": jnp.zeros((3, 4, 16, 8)),
+                "cached_value": jnp.zeros((3, 4, 16, 8)),
+                "cache_index": jnp.zeros((3,), jnp.int32),
+            },
+            "pos_index": jnp.zeros((3,), jnp.int32),
+        }
+    }
+    specs = decode_cache_specs(cache)
+    assert specs["layer"]["attn"]["cached_key"] == P(None, MODEL_AXIS,
+                                                    None, None)
+    assert specs["layer"]["attn"]["cached_value"] == P(None, MODEL_AXIS,
+                                                      None, None)
+    assert specs["layer"]["attn"]["cache_index"] == P()
+    assert specs["layer"]["pos_index"] == P()
+
+
+def test_sharded_serving_token_identity(compiled, devices):
+    """The full matrix — ragged prompts, slot reuse, mid-decode
+    admission — served identically by an unsharded engine and a 4-way
+    tensor-parallel one, each with exactly one prefill + one decode
+    compile."""
+    mesh = build_mesh(num_data=2, num_model=4)
+    prompts = [[5, 3, 9], [7, 2, 8, 4, 1, 6], [11, 12], [1, 2, 3, 4]]
+    results = {}
+    for tag, m in (("plain", None), ("tp", mesh)):
+        eng = _tp_engine(compiled, m, max_slots=2)
+        rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        results[tag] = [eng.result(r, timeout_s=240).tokens for r in rids]
+        stats = eng.stats()
+        assert stats["prefill_traces"] == 1, f"{tag}: prefill retraced"
+        assert stats["decode_traces"] == 1, f"{tag}: decode retraced"
+        if m is not None:
+            # The pool's K/V leaves really live sharded on the mesh.
+            def kv_leaves(tree):
+                flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+                return [
+                    (kp, leaf) for kp, leaf in flat
+                    if getattr(kp[-1], "key", "") in
+                    ("cached_key", "cached_value")
+                ]
+
+            leaves = kv_leaves(eng.pool.cache)
+            assert leaves
+            for kp, leaf in leaves:
+                spec = leaf.sharding.spec
+                assert spec[1] == MODEL_AXIS, (kp, spec)
+                # 4-way head sharding: each shard holds heads/4.
+                shard_shape = leaf.sharding.shard_shape(leaf.shape)
+                assert shard_shape[1] == leaf.shape[1] // 4
+    assert results["tp"] == results["plain"]
+    for got, p in zip(results["tp"], prompts):
+        assert got == _per_row(compiled, p, 6)
+
+
+def test_shard_serving_refuses_warm_engine(compiled, devices):
+    """Re-jitting warm programs would break the one-compile invariant,
+    so a served-on engine refuses to shard."""
+    mesh = build_mesh(num_data=2, num_model=4)
+    eng = _tp_engine(compiled, None)
+    eng.result(eng.submit([5, 3, 9], max_new_tokens=2), timeout_s=120)
+    with pytest.raises(RuntimeError, match="before the first request"):
+        eng.shard_serving(mesh)
+
+
+def test_shard_serving_rejects_indivisible_heads(compiled, devices):
+    """KV head sharding needs heads % model-axis == 0 — a loud error,
+    not a silent GSPMD fallback."""
+    mesh = build_mesh(num_data=1, num_model=8)  # 4 heads over 8 devices
+    eng = _tp_engine(compiled, None)
+    with pytest.raises(ValueError, match="num_heads"):
+        eng.shard_serving(mesh)
