@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elephas_tpu import obs
 from elephas_tpu.engine.state import TrainState
 from elephas_tpu.engine.step import make_epoch_scanner, make_train_step
 from elephas_tpu.parallel.mesh import DATA_AXIS
@@ -803,31 +804,43 @@ class AsyncTrainer:
 
         opt_state = None
         epoch_metrics: List[Dict[str, float]] = []
+        # Worker threads each get their own tid row in the trace (events
+        # without an explicit track land on the recording thread's name),
+        # so per-worker pull/train/push phases read as parallel lanes.
+        tracer = obs.default_tracer()
 
         def pull_state(step: int, attempt: int = 0) -> TrainState:
             nonlocal opt_state
-            pulled = client.get_parameters()
-            params = jax.device_put(pulled["params"], device)
-            batch_stats = jax.device_put(pulled["batch_stats"], device)
-            if opt_state is None:
-                opt_state = jax.device_put(compiled.init_opt_state(params), device)
-            rng = jax.random.fold_in(jax.random.fold_in(self._base_rng, index), step)
-            if attempt:  # retry of this unit: a distinct dropout stream
-                rng = jax.random.fold_in(rng, 10_000 + attempt)
-            return TrainState.create(
-                params=params,
-                opt_state=opt_state,
-                batch_stats=batch_stats,
-                rng=jax.device_put(rng, device),
-                step=step,
-            )
+            with tracer.span("async/pull", worker=index, step=step):
+                pulled = client.get_parameters()
+                params = jax.device_put(pulled["params"], device)
+                batch_stats = jax.device_put(pulled["batch_stats"], device)
+                if opt_state is None:
+                    opt_state = jax.device_put(
+                        compiled.init_opt_state(params), device
+                    )
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(self._base_rng, index), step
+                )
+                if attempt:  # retry of this unit: a distinct dropout stream
+                    rng = jax.random.fold_in(rng, 10_000 + attempt)
+                return TrainState.create(
+                    params=params,
+                    opt_state=opt_state,
+                    batch_stats=batch_stats,
+                    rng=jax.device_put(rng, device),
+                    step=step,
+                )
 
         def push_delta(before: TrainState, after: TrainState) -> None:
-            delta = {
-                "params": self._subtract(before.params, after.params),
-                "batch_stats": self._subtract(before.batch_stats, after.batch_stats),
-            }
-            client.update_parameters(delta)
+            with tracer.span("async/push", worker=index):
+                delta = {
+                    "params": self._subtract(before.params, after.params),
+                    "batch_stats": self._subtract(
+                        before.batch_stats, after.batch_stats
+                    ),
+                }
+                client.update_parameters(delta)
 
         def run_unit(unit):
             """Spark's ``spark.task.maxFailures`` analogue (SURVEY.md §5.3):
@@ -866,6 +879,10 @@ class AsyncTrainer:
                     if attempt + 1 >= self.max_failures:
                         raise
                     epoch_retries += 1
+                    obs.default_registry().counter(
+                        "worker_retry_total",
+                        help="frequency-unit retries across all workers",
+                    ).inc()
 
         epoch_retries = 0
 
@@ -1068,12 +1085,16 @@ class AsyncTrainer:
                     state = pull_state(global_step, attempt)
                     self._mark_phase("pull", t0, state.params)
                     t0 = time.perf_counter()
-                    new_state, metrics = self._epoch_fn(state, ex_d, ey_d)
-                    # Fetching metrics forces the whole epoch scan, so a
-                    # device-side fault raises HERE (retryable) before the
-                    # delta is pushed — a poisoned delta must never reach
-                    # the shared buffer.
-                    fetched = {k: float(v) for k, v in jax.device_get(metrics).items()}
+                    with tracer.span("async/train", worker=index, epoch=epoch):
+                        new_state, metrics = self._epoch_fn(state, ex_d, ey_d)
+                        # Fetching metrics forces the whole epoch scan, so a
+                        # device-side fault raises HERE (retryable) before the
+                        # delta is pushed — a poisoned delta must never reach
+                        # the shared buffer.
+                        fetched = {
+                            k: float(v)
+                            for k, v in jax.device_get(metrics).items()
+                        }
                     self._mark_phase("train", t0, new_state.params)
                     t0 = time.perf_counter()
                     push_delta(state, new_state)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -32,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from elephas_tpu import obs
 from elephas_tpu.engine.state import TrainState
 from elephas_tpu.engine.step import (
     DeviceEvalCache,
@@ -302,18 +304,30 @@ class SyncTrainer:
         if self.frequency == _PER_FIT:
             return self._fit_parity(state, xs, ys, epochs, validation_data, verbose)
 
+        tracer = obs.default_tracer()
+        epoch_hist = obs.default_registry().histogram(
+            "train_epoch_s", help="wall seconds per dispatched training epoch"
+        )
         history: Dict[str, List[float]] = {}
         for epoch in range(epochs):
-            state, metrics = self._epoch_fn(state, xs, ys, jnp.int32(epoch))
-            metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+            t_ep = time.perf_counter()
+            # The span covers dispatch AND the metrics fetch — the fetch
+            # is where the host actually blocks on the epoch program.
+            with tracer.span("train/epoch", mode="sync", epoch=epoch):
+                state, metrics = self._epoch_fn(state, xs, ys, jnp.int32(epoch))
+                metrics = {
+                    k: float(v) for k, v in jax.device_get(metrics).items()
+                }
+            epoch_hist.observe(time.perf_counter() - t_ep)
             if validation_data is not None:
                 # Eval in chunks of >=512 regardless of the (often tiny)
                 # training batch: each chunk is a host->device round-trip,
                 # and on a remote-tunneled chip the RTT of 64 tiny chunks
                 # dwarfs the eval compute. Weighted mean is exact either way.
-                val = self.evaluate_state(
-                    state, *validation_data, batch_size=max(batch_size, 512)
-                )
+                with tracer.span("train/eval", epoch=epoch):
+                    val = self.evaluate_state(
+                        state, *validation_data, batch_size=max(batch_size, 512)
+                    )
                 metrics.update({f"val_{k}": v for k, v in val.items()})
             for key, value in metrics.items():
                 history.setdefault(key, []).append(value)
@@ -484,23 +498,25 @@ class SyncTrainer:
                 jax.device_put(fy, data_sharding),
             )
 
+        tracer = obs.default_tracer()
         history: Dict[str, List[float]] = {}
         for epoch in range(epochs):
             perms = [host_rng.permutation(rows_per_shard) for _ in range(n_shards)]
             bounds = list(range(0, nb, stream_batches)) + [nb]
             spans = list(zip(bounds[:-1], bounds[1:]))
-            nxt = assemble(*spans[0], perms)
-            chunk_metrics = []
-            for i, (b0, b1) in enumerate(spans):
-                cur = nxt
-                state_block, metrics = chunk_fn(state_block, *cur)  # async dispatch
-                if i + 1 < len(spans):  # overlap host assembly with device compute
-                    nxt = assemble(*spans[i + 1], perms)
-                chunk_metrics.append((b1 - b0, metrics))
-            state_block = epoch_end_fn(state_block)
+            with tracer.span("train/epoch", mode="sync-stream", epoch=epoch):
+                nxt = assemble(*spans[0], perms)
+                chunk_metrics = []
+                for i, (b0, b1) in enumerate(spans):
+                    cur = nxt
+                    state_block, metrics = chunk_fn(state_block, *cur)  # async dispatch
+                    if i + 1 < len(spans):  # overlap host assembly with device compute
+                        nxt = assemble(*spans[i + 1], perms)
+                    chunk_metrics.append((b1 - b0, metrics))
+                state_block = epoch_end_fn(state_block)
 
-            total = sum(w for w, _ in chunk_metrics)
-            fetched = jax.device_get([m for _, m in chunk_metrics])
+                total = sum(w for w, _ in chunk_metrics)
+                fetched = jax.device_get([m for _, m in chunk_metrics])
             metrics = {
                 k: float(sum(w * d[k] for (w, _), d in zip(chunk_metrics, fetched)) / total)
                 for k in fetched[0]

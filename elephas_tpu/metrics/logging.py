@@ -23,11 +23,18 @@ import jax
 
 
 def host0_logger(name: str = "elephas_tpu", level: int = logging.INFO) -> logging.Logger:
-    """Process-0-only logger (every host logging identically is noise)."""
+    """Process-0-only logger (every host logging identically is noise).
+
+    Idempotent: repeated calls (every module grabs its logger through
+    here) must not stack a new ``NullHandler`` per call — handler lists
+    grow without bound otherwise, and logging iterates them per record."""
     logger = logging.getLogger(name)
     logger.setLevel(level)
     if jax.process_index() != 0:
-        logger.addHandler(logging.NullHandler())
+        if not any(
+            isinstance(h, logging.NullHandler) for h in logger.handlers
+        ):
+            logger.addHandler(logging.NullHandler())
         logger.propagate = False
     return logger
 

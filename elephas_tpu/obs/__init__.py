@@ -1,0 +1,72 @@
+"""Unified observability layer: span tracing + metrics registry.
+
+Everything here is HOST-side only and allocation-light — no device
+syncs, no per-sample storage — so instrumentation can stay on inside
+the pipelined serving scheduler's overlap window (the bench guardrail
+in ``scripts/lm_bench.py`` pins the traced/untraced gap under 2%).
+
+Two process-global defaults back cross-cutting instrumentation (the
+training engines, parameter-server clients, and compile counters all
+record through them):
+
+- ``default_tracer()`` — starts as the shared disabled ``NULL_TRACER``
+  (every span is a no-op); ``enable_tracing()`` swaps in a live ring.
+- ``default_registry()`` — always live (counters/gauges/histograms are
+  a few ints each); scrape with ``default_registry().expose_text()``.
+
+The serving ``InferenceEngine`` instead takes an explicit ``tracer=``
+(its clock is injectable and the tracer must share it); it falls back
+to the global default when none is passed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from elephas_tpu.obs.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_latency_buckets,
+)
+from elephas_tpu.obs.trace import (  # noqa: F401
+    NULL_TRACER,
+    SpanEvent,
+    Tracer,
+)
+
+_tracer: Tracer = NULL_TRACER
+_registry = MetricsRegistry()
+
+
+def default_tracer() -> Tracer:
+    """The process-global tracer (disabled until ``enable_tracing``)."""
+    return _tracer
+
+
+def set_default_tracer(tracer: Optional[Tracer]) -> Tracer:
+    """Install ``tracer`` as the global default (None → disabled)."""
+    global _tracer
+    _tracer = tracer if tracer is not None else NULL_TRACER
+    return _tracer
+
+
+def enable_tracing(capacity: int = 65536, clock=time.monotonic,
+                   annotate_device: bool = True) -> Tracer:
+    """Swap a live ring in as the global tracer and return it."""
+    return set_default_tracer(
+        Tracer(capacity=capacity, clock=clock,
+               annotate_device=annotate_device)
+    )
+
+
+def disable_tracing() -> None:
+    """Back to the shared no-op tracer (recorded events are dropped)."""
+    set_default_tracer(None)
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-global metrics registry (always live)."""
+    return _registry

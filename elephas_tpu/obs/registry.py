@@ -1,0 +1,244 @@
+"""Metrics registry: named counters, gauges, fixed-bucket histograms.
+
+The registry is the scrape surface the ROADMAP's serving north star
+needs: in-memory aggregation only (recording a sample is an integer
+bump — no allocation, no device sync, safe inside the pipelined
+scheduler's overlap window), read out either as a Prometheus-style text
+exposition (``expose_text``) or as one structured line through the
+existing ``metrics.logging.JsonlSink`` (``log_to`` — the same artifact
+format every committed benchmark in this repo uses).
+
+``Histogram`` gives p50/p90/p99 without storing every sample: fixed
+bucket bounds (default: a geometric latency ladder from 10µs to ~80s),
+percentiles linearly interpolated inside the owning bucket and clamped
+to the observed min/max, so the estimate is never wider than one bucket
+off the exact quantile (tests pin this against exact quantiles on known
+distributions).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_latency_buckets",
+]
+
+
+def default_latency_buckets() -> Tuple[float, ...]:
+    """Geometric ladder 10µs → ~80s (×2 per bucket, 24 bounds): spans a
+    sub-ms decode step and a minute-long compile in one histogram."""
+    return tuple(1e-5 * 2 ** i for i in range(24))
+
+
+class Counter:
+    """Monotonic counter (``inc`` only)."""
+
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar."""
+
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated percentiles.
+
+    ``buckets`` are ascending upper bounds; an implicit +inf bucket
+    catches overflow. Observing is two comparisons + two integer bumps
+    (bisect over ~24 bounds); nothing per-sample is stored beyond
+    count/sum/min/max, so a million decode steps cost the same memory
+    as ten.
+    """
+
+    __slots__ = ("name", "help", "bounds", "counts", "count", "sum",
+                 "min", "max")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Optional[Iterable[float]] = None):
+        self.name = name
+        self.help = help
+        bounds = tuple(sorted(buckets)) if buckets is not None \
+            else default_latency_buckets()
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # trailing +inf bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        # Hand-rolled bisect_right over a ~24-entry tuple: no imports in
+        # the hot path, O(log n) either way.
+        lo, hi = 0, len(self.bounds)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if v <= self.bounds[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        self.counts[lo] += 1
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimated ``q``-quantile (q in [0, 1]); None when empty.
+
+        Linear interpolation inside the bucket holding the target rank,
+        clamped to [observed min, observed max] — so degenerate
+        single-bucket data still reports sane numbers.
+        """
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        if self.count == 0:
+            return None
+        rank = q * self.count
+        cum = 0.0
+        lower = self.min
+        for i, c in enumerate(self.counts):
+            upper = self.bounds[i] if i < len(self.bounds) else self.max
+            if c and cum + c >= rank:
+                frac = (rank - cum) / c
+                est = lower + (min(upper, self.max) - lower) * frac
+                return min(max(est, self.min), self.max)
+            cum += c
+            if i < len(self.bounds):
+                lower = max(self.bounds[i], self.min)
+        return self.max
+
+    def percentiles(self, qs=(0.50, 0.95, 0.99)) -> Dict[str, Optional[float]]:
+        """``{"p50": ..., "p95": ..., "p99": ...}`` convenience dict."""
+        return {f"p{int(q * 100)}": self.percentile(q) for q in qs}
+
+
+class MetricsRegistry:
+    """Name → instrument map with get-or-create accessors.
+
+    Accessors are idempotent (same name returns the same instrument) and
+    kind-checked — registering ``"x"`` as both a counter and a gauge is
+    a programming error worth failing loudly on.
+    """
+
+    def __init__(self):
+        self._instruments: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, help=help, **kw)
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, not {cls.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Iterable[float]] = None) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def instruments(self) -> List[object]:
+        with self._lock:
+            return [self._instruments[k] for k in sorted(self._instruments)]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+    # -- readout -----------------------------------------------------------
+
+    def expose_text(self) -> str:
+        """Prometheus-style text exposition (scrape/dump surface)."""
+        lines: List[str] = []
+        for inst in self.instruments():
+            kind = type(inst).__name__.lower()
+            if inst.help:
+                lines.append(f"# HELP {inst.name} {inst.help}")
+            lines.append(f"# TYPE {inst.name} {kind}")
+            if isinstance(inst, Histogram):
+                cum = 0
+                for bound, c in zip(inst.bounds, inst.counts):
+                    cum += c
+                    lines.append(
+                        f'{inst.name}_bucket{{le="{bound:g}"}} {cum}'
+                    )
+                lines.append(
+                    f'{inst.name}_bucket{{le="+Inf"}} {inst.count}'
+                )
+                lines.append(f"{inst.name}_sum {inst.sum:g}")
+                lines.append(f"{inst.name}_count {inst.count}")
+            else:
+                lines.append(f"{inst.name} {inst.value:g}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat name → number dict; histograms expand to
+        ``_count``/``_sum``/``_p50``/``_p95``/``_p99``."""
+        out: Dict[str, float] = {}
+        for inst in self.instruments():
+            if isinstance(inst, Histogram):
+                out[f"{inst.name}_count"] = inst.count
+                out[f"{inst.name}_sum"] = inst.sum
+                for key, v in inst.percentiles().items():
+                    if v is not None:
+                        out[f"{inst.name}_{key}"] = v
+            else:
+                out[inst.name] = inst.value
+        return out
+
+    def log_to(self, sink, step: int = 0, **extra) -> None:
+        """One structured line into a ``metrics.logging.JsonlSink``
+        (duck-typed: anything with ``log(step, **metrics)``)."""
+        sink.log(step, event="metrics", **{**self.snapshot(), **extra})

@@ -1,0 +1,256 @@
+"""Span tracer: bounded in-memory ring buffer → Chrome ``trace_event``.
+
+The serving/training hot paths need per-phase wall-clock visibility
+(PR 2's single ``dispatch_to_fetch_s`` gauge steered a 15× win — this
+generalizes it) without ever touching the device: recording a span is a
+clock read plus one append into a ``deque(maxlen=...)`` ring, so it can
+stay on inside the pipelined scheduler's overlap window. The ring drops
+the OLDEST events when full — a long-running server keeps the recent
+past instead of dying or growing without bound.
+
+Two recording styles, one event format:
+
+- ``with tracer.span("prefill", req_id=3):`` — reads the tracer's clock
+  on enter/exit (training loops, parameter-server push/pull);
+- ``tracer.record("queue", begin_s, end_s, track="req:3")`` — a span
+  whose endpoints the CALLER already timestamped with the same clock
+  (the serving scheduler, whose injectable ``clock`` the fake-clock
+  tests replace — pass that clock to the ``Tracer`` so both styles land
+  in one time domain).
+
+Export is Chrome ``trace_event`` JSON (``{"traceEvents": [...]}``),
+viewable in Perfetto / ``chrome://tracing``. Each distinct ``track``
+becomes a named thread row, so per-request spans (``track="req:7"``)
+render as one lane per request with phases nested by containment —
+``scripts/trace_report.py`` reads the same file back into per-phase
+percentiles and a request tree.
+
+Device correlation: when ``annotate_device=True`` (default) every
+``span()`` also enters ``jax.profiler.TraceAnnotation``, so if a
+``jax.profiler`` trace window is open (``metrics.logging.trace``) the
+SAME span names appear on the host rows of the device trace, lined up
+with the XLA ops they caused. The annotation is a no-op outside a
+profiler window — cost is one small object.
+
+Disabled tracers are free: ``span()`` returns a shared null context
+(no allocation), ``record``/``instant`` return before touching the
+clock. ``NULL_TRACER`` is the module's shared disabled instance —
+instrumented code can hold it unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["SpanEvent", "Tracer", "NULL_TRACER"]
+
+_NULL_CTX = contextlib.nullcontext()  # shared: disabled span() allocates nothing
+
+
+class SpanEvent:
+    """One recorded span (or instant, when ``end_s == begin_s``)."""
+
+    __slots__ = ("name", "begin_s", "end_s", "track", "args")
+
+    def __init__(self, name: str, begin_s: float, end_s: float,
+                 track: Optional[str], args: Optional[Dict[str, Any]]):
+        self.name = name
+        self.begin_s = begin_s
+        self.end_s = end_s
+        self.track = track
+        self.args = args
+
+    @property
+    def duration_s(self) -> float:
+        return self.end_s - self.begin_s
+
+    def __repr__(self):
+        return (f"SpanEvent({self.name!r}, {self.begin_s:.6f}→"
+                f"{self.end_s:.6f}, track={self.track!r})")
+
+
+class _Span:
+    """Live ``span()`` context — clock on enter, ring append on exit."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_begin", "_annotation")
+
+    def __init__(self, tracer: "Tracer", name: str, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+        self._begin = 0.0
+        self._annotation = None
+
+    def __enter__(self):
+        tracer = self._tracer
+        if tracer._annotate:
+            annotation = tracer._device_annotation(self._name)
+            if annotation is not None:
+                self._annotation = annotation
+                annotation.__enter__()
+        self._begin = tracer.clock()
+        return self
+
+    def __exit__(self, *exc):
+        tracer = self._tracer
+        end = tracer.clock()
+        if self._annotation is not None:
+            self._annotation.__exit__(*exc)
+        # Track = recording thread: async trainer workers are threads,
+        # so each worker's pull/train/push phases get their own row.
+        tracer._events.append(
+            SpanEvent(self._name, self._begin, end,
+                      threading.current_thread().name, self._args)
+        )
+        return False
+
+
+class Tracer:
+    """Bounded host-side span recorder.
+
+    Parameters
+    ----------
+    capacity: ring size in events; the oldest are dropped when full.
+    clock: monotonic seconds source. MUST match the clock of any caller
+        that records retroactive spans (``record``) — the serving engine
+        passes its own injectable clock through.
+    enabled: a disabled tracer records nothing and ``span()`` returns a
+        shared null context (zero allocation).
+    annotate_device: bridge each ``span()`` into
+        ``jax.profiler.TraceAnnotation`` so host spans line up with XLA
+        ops inside an open profiler trace window.
+    """
+
+    def __init__(self, capacity: int = 65536, clock=time.monotonic,
+                 enabled: bool = True, annotate_device: bool = True):
+        if capacity < 0:
+            raise ValueError(f"capacity must be >= 0, got {capacity}")
+        self.capacity = capacity
+        self.clock = clock
+        self.enabled = enabled
+        self._annotate = annotate_device
+        self._events: deque = deque(maxlen=capacity)
+        self._annotation_cls = None  # resolved lazily (jax import)
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, **args):
+        """Context manager recording ``name`` from enter to exit."""
+        if not self.enabled:
+            return _NULL_CTX
+        return _Span(self, name, args or None)
+
+    def record(self, name: str, begin_s: float, end_s: float,
+               track: Optional[str] = None, **args) -> None:
+        """Record a span whose endpoints the caller already timestamped
+        (with THIS tracer's clock domain)."""
+        if not self.enabled:
+            return
+        if track is None:
+            track = threading.current_thread().name
+        self._events.append(
+            SpanEvent(name, begin_s, end_s, track, args or None)
+        )
+
+    def instant(self, name: str, at: Optional[float] = None,
+                track: Optional[str] = None, **args) -> None:
+        """Zero-duration marker (defaults to now)."""
+        if not self.enabled:
+            return
+        t = self.clock() if at is None else at
+        if track is None:
+            track = threading.current_thread().name
+        self._events.append(SpanEvent(name, t, t, track, args or None))
+
+    def _device_annotation(self, name: str):
+        """A ``jax.profiler.TraceAnnotation`` for ``name``, or None when
+        jax (or the annotation API) is unavailable — the tracer must
+        work in stripped environments."""
+        if self._annotation_cls is None:
+            try:
+                from jax.profiler import TraceAnnotation
+                self._annotation_cls = TraceAnnotation
+            except Exception:  # no jax / no profiler: disable the bridge
+                self._annotate = False
+                return None
+        try:
+            return self._annotation_cls(name)
+        except Exception:
+            self._annotate = False
+            return None
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def events(self) -> List[SpanEvent]:
+        """Snapshot of the ring (oldest first)."""
+        return list(self._events)
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    # -- export ------------------------------------------------------------
+
+    def to_chrome_events(self) -> List[dict]:
+        """The ring as Chrome ``trace_event`` dicts (microsecond ts,
+        normalized so the earliest event sits at t=0).
+
+        Each distinct ``track`` becomes one named tid row (thread-name
+        metadata events included), untracked spans share a row per
+        recording thread name; Perfetto nests spans on a row by time
+        containment.
+        """
+        events = self.events()
+        if not events:
+            return []
+        t0 = min(e.begin_s for e in events)
+        tids: Dict[str, int] = {}
+        out: List[dict] = []
+
+        def tid_for(track: str) -> int:
+            if track not in tids:
+                tids[track] = len(tids) + 1
+                out.append({
+                    "name": "thread_name", "ph": "M", "pid": 0,
+                    "tid": tids[track], "args": {"name": track},
+                })
+            return tids[track]
+
+        main = threading.main_thread().name
+        for e in events:
+            rec = {
+                "name": e.name,
+                "ph": "X",
+                "pid": 0,
+                "tid": tid_for(e.track if e.track is not None else main),
+                "ts": (e.begin_s - t0) * 1e6,
+                "dur": max(e.end_s - e.begin_s, 0.0) * 1e6,
+            }
+            if e.args:
+                rec["args"] = dict(e.args)
+            out.append(rec)
+        return out
+
+    def export_chrome(self, path: Optional[str] = None):
+        """Dump the ring as a Perfetto-viewable trace. Returns the
+        ``{"traceEvents": [...]}`` dict; also writes it to ``path``
+        when given."""
+        doc = {
+            "traceEvents": self.to_chrome_events(),
+            "displayTimeUnit": "ms",
+        }
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc
+
+
+#: Shared disabled instance — hold it unconditionally in instrumented code.
+NULL_TRACER = Tracer(capacity=0, enabled=False, annotate_device=False)

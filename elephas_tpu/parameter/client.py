@@ -38,6 +38,7 @@ from typing import Optional
 
 import jax
 
+from elephas_tpu import obs
 from elephas_tpu.parameter.base import BaseParameterClient
 from elephas_tpu.parameter.buffer import ParameterBuffer
 from elephas_tpu.utils import sockets as socket_utils
@@ -45,6 +46,14 @@ from elephas_tpu.utils import sockets as socket_utils
 # Connection-failure retry schedule: total sleep ~2.8s before giving up.
 _RETRY_DELAYS = (0.1, 0.2, 0.4, 0.8, 1.3)
 _CONNECT_TIMEOUT = 2.0  # dial budget per attempt (transfers get self.timeout)
+
+
+def _ps_span(op: str, transport: str):
+    """Span + counter for one PS round-trip; every client's pull/push
+    funnels through here so ``ps/pull``/``ps/push`` rows mean the same
+    thing across local, http, and socket transports."""
+    obs.default_registry().counter(f"ps_{op}_total").inc()
+    return obs.default_tracer().span(f"ps/{op}", transport=transport)
 
 
 class ParameterServerUnavailable(ConnectionError):
@@ -81,10 +90,12 @@ class LocalClient(BaseParameterClient):
         self._buffer = buffer
 
     def get_parameters(self):
-        return self._buffer.get()
+        with _ps_span("pull", "local"):
+            return self._buffer.get()
 
     def update_parameters(self, delta) -> None:
-        self._buffer.apply_delta(delta)
+        with _ps_span("push", "local"):
+            self._buffer.apply_delta(delta)
 
     def wait_barrier(self, tag: str, n: int, timeout: Optional[float] = None) -> None:
         pass  # in-process buffer == single host; nothing to synchronize
@@ -219,12 +230,14 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
         return self._call("POST", path, payload, op)
 
     def get_parameters(self):
-        return pickle.loads(self._get("/parameters", "get_parameters"))
+        with _ps_span("pull", "http"):
+            return pickle.loads(self._get("/parameters", "get_parameters"))
 
     def update_parameters(self, delta) -> None:
-        delta = jax.device_get(delta)
-        payload = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)
-        self._post("/update", payload, "update_parameters")
+        with _ps_span("push", "http"):
+            delta = jax.device_get(delta)
+            payload = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)
+            self._post("/update", payload, "update_parameters")
 
     def health(self) -> bool:
         """One non-retried probe of ``GET /health``, bounded end-to-end by
@@ -332,13 +345,15 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
             pass
 
     def get_parameters(self):
-        with self._lock:
+        with _ps_span("pull", "socket"), self._lock:
             return self._roundtrip(("g", None), "get_parameters", idempotent=True)
 
     def update_parameters(self, delta) -> None:
-        delta = jax.device_get(delta)
-        with self._lock:
-            self._roundtrip(("u", delta), "update_parameters", idempotent=False)
+        with _ps_span("push", "socket"):
+            delta = jax.device_get(delta)
+            with self._lock:
+                self._roundtrip(("u", delta), "update_parameters",
+                                idempotent=False)
 
     def health(self) -> bool:
         """Liveness probe: a barrier *count* on a FRESH connection.

@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from elephas_tpu import obs
 from elephas_tpu.serving.kv_pool import KVCachePool
 from elephas_tpu.serving.metrics import ServingMetrics
 from elephas_tpu.serving.scheduler import (
@@ -95,6 +96,12 @@ class InferenceEngine:
         unpipelined oracle path — token-identical, device idles during
         host bookkeeping; exists for A/B tests and benchmarks.
     sink: optional ``metrics.JsonlSink`` for request/step records.
+    tracer: optional ``obs.Tracer`` recording the per-request span tree
+        (submit→queue→admit→prefill→decode→finish, one ``req:<id>``
+        track each) plus per-iteration scheduler spans. Defaults to the
+        process-global tracer (a no-op unless ``obs.enable_tracing()``
+        ran). The tracer's ``clock`` must match the engine's — both
+        default to ``time.monotonic``.
     """
 
     def __init__(
@@ -114,6 +121,7 @@ class InferenceEngine:
         pipeline: bool = True,
         sink=None,
         clock=time.monotonic,
+        tracer=None,
     ):
         module = getattr(compiled, "module", compiled)
         if params is None:
@@ -144,6 +152,7 @@ class InferenceEngine:
         self._rng = jax.random.PRNGKey(seed)
         self._greedy = temperature == 0.0
 
+        self.tracer = tracer if tracer is not None else obs.default_tracer()
         self.pool = KVCachePool(self.decode_module, max_slots, max_len)
         self.queue = RequestQueue(max_depth=queue_depth)
         self.metrics = ServingMetrics(sink=sink, clock=clock)
@@ -157,6 +166,7 @@ class InferenceEngine:
             metrics=self.metrics,
             clock=clock,
             pipeline=pipeline,
+            tracer=self.tracer,
         )
 
         self._prefill_traces = 0
@@ -191,8 +201,14 @@ class InferenceEngine:
     # -- compiled bodies ---------------------------------------------------
 
     def _prefill_impl(self, params, prompt, pad_offset, rng):
-        # Traced once per compilation — the counter measures retraces.
+        # Traced once per compilation — the counter measures retraces,
+        # and the obs hook makes a surprise retrace (a silent 10×
+        # regression if it happened per request) a visible counter +
+        # trace marker.
         self._prefill_traces += 1
+        from elephas_tpu.utils.compiler import note_retrace
+
+        note_retrace("serving_prefill", count=self._prefill_traces)
         from elephas_tpu.models.transformer import (
             make_decode_cache,
             sample_tokens,
@@ -215,6 +231,9 @@ class InferenceEngine:
     def _decode_impl(self, params, cache, prev_tokens, override_vals,
                      override_mask, active_mask, pad, rng):
         self._decode_traces += 1
+        from elephas_tpu.utils.compiler import note_retrace
+
+        note_retrace("serving_decode", count=self._decode_traces)
         from elephas_tpu.models.transformer import sample_tokens
 
         # Freshly-admitted lanes get their prefill first token here,
@@ -363,6 +382,10 @@ class InferenceEngine:
             self.metrics.record_reject()
             raise
         self.metrics.record_submit()
+        self.tracer.instant(
+            "submit", at=now, track=f"req:{req.req_id}",
+            req_id=req.req_id, prompt_tokens=len(prompt),
+        )
         return req.req_id
 
     def submit_with_retry(self, prompt, **kwargs) -> int:

@@ -29,6 +29,14 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from elephas_tpu.obs import Histogram
+
+# The three latency families summary() reports percentiles for. Raw
+# sample lists are kept alongside (tests and notebooks read them); the
+# histograms are what the percentile estimates come from, so the same
+# numbers keep working if the lists are ever dropped for long runs.
+_LATENCY_KEYS = ("ttft_s", "itl_s", "dispatch_to_fetch_s")
+
 
 class ServingMetrics:
     """Aggregator + JSONL emitter for the serving engine."""
@@ -48,6 +56,7 @@ class ServingMetrics:
         self.ttft_s: list = []
         self.itl_s: list = []
         self.dispatch_to_fetch_s: list = []
+        self.histograms = {k: Histogram(k) for k in _LATENCY_KEYS}
         self._last_overlap: Optional[float] = None
         self._t0: Optional[float] = None
 
@@ -66,6 +75,7 @@ class ServingMetrics:
         self.ttft_s = []
         self.itl_s = []
         self.dispatch_to_fetch_s = []
+        self.histograms = {k: Histogram(k) for k in _LATENCY_KEYS}
         self._last_overlap = None
         self._t0 = None
 
@@ -87,8 +97,10 @@ class ServingMetrics:
         self.tokens_out += len(result.tokens)
         if result.ttft_s is not None:
             self.ttft_s.append(result.ttft_s)
+            self.histograms["ttft_s"].observe(result.ttft_s)
         if result.itl_s_avg is not None:
             self.itl_s.append(result.itl_s_avg)
+            self.histograms["itl_s"].observe(result.itl_s_avg)
         if self.sink is not None:
             self.sink.log(
                 self.steps,
@@ -110,6 +122,7 @@ class ServingMetrics:
         """Dispatch→fetch wall time for one decode step (the window the
         pipelined scheduler hides host bookkeeping in)."""
         self.dispatch_to_fetch_s.append(seconds)
+        self.histograms["dispatch_to_fetch_s"].observe(seconds)
         self._last_overlap = seconds
 
     def record_step(self, queue_depth: int, active: int, tokens: int,
@@ -134,7 +147,7 @@ class ServingMetrics:
     def summary(self) -> dict:
         elapsed = None if self._t0 is None else self.clock() - self._t0
         mean = lambda xs: (sum(xs) / len(xs)) if xs else None  # noqa: E731
-        return {
+        out = {
             "submitted": self.requests_submitted,
             "completed": self.requests_completed,
             "timed_out": self.requests_timed_out,
@@ -150,3 +163,9 @@ class ServingMetrics:
                 self.tokens_out / elapsed if elapsed else None
             ),
         }
+        # Tail latencies (bucketed estimates, obs.Histogram): averages
+        # hide exactly the stall spikes serving SLOs are written against.
+        for key, hist in self.histograms.items():
+            for pkey, v in hist.percentiles().items():
+                out[f"{key}_{pkey}"] = v
+        return out

@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elephas_tpu import obs
 from elephas_tpu.serving import host_sync
 
 
@@ -131,6 +132,7 @@ class _Active:
     tokens: List[int]                    # generated so far (incl. first)
     token_times: List[float]             # wall time per token, for ITL
     budget: int                          # tokens still allowed (cache cap)
+    admitted_at: float = 0.0             # decode-batch join time (spans)
 
 
 @dataclass
@@ -173,6 +175,7 @@ class ContinuousBatchingScheduler:
         metrics=None,
         clock=time.monotonic,
         pipeline: bool = True,
+        tracer=None,
     ):
         self.pool = pool
         self.queue = queue
@@ -183,6 +186,11 @@ class ContinuousBatchingScheduler:
         self.metrics = metrics
         self.clock = clock
         self.pipeline = pipeline
+        # Span recording: retroactive `record()` calls with THIS clock's
+        # timestamps — the tracer must share the clock domain (the
+        # engine passes its own). A disabled tracer makes every call a
+        # cheap early return, so recording can stay in the hot path.
+        self.tracer = tracer if tracer is not None else obs.default_tracer()
         self._active: Dict[int, _Active] = {}  # slot -> _Active
         self._results: List[GenerationResult] = []
         self._inflight: Optional[_Inflight] = None
@@ -227,6 +235,22 @@ class ContinuousBatchingScheduler:
                 len(entry.tokens) / span if span and span > 0 else None
             ),
         )
+        if self.tracer.enabled:
+            now = self.clock()
+            track = f"req:{req.req_id}"
+            if times and times[-1] > entry.admitted_at:
+                self.tracer.record(
+                    "decode", entry.admitted_at, times[-1], track=track,
+                    req_id=req.req_id, tokens=len(entry.tokens),
+                )
+            self.tracer.instant(
+                "finish", at=now, track=track, req_id=req.req_id,
+                status=status,
+            )
+            self.tracer.record(
+                "request", req.submitted_at, now, track=track,
+                req_id=req.req_id, status=status, tokens=len(entry.tokens),
+            )
         self._results.append(result)
         if self.metrics is not None:
             self.metrics.record_finish(
@@ -249,9 +273,19 @@ class ContinuousBatchingScheduler:
             req = self.queue.pop()
             if req is None:
                 return
+            t_pop = self.clock()
+            track = f"req:{req.req_id}"
             # A request can expire while still queued — don't burn a
             # prefill on it.
-            if req.deadline is not None and self.clock() >= req.deadline:
+            if req.deadline is not None and t_pop >= req.deadline:
+                self.tracer.record(
+                    "queue", req.submitted_at, t_pop, track=track,
+                    req_id=req.req_id,
+                )
+                self.tracer.record(
+                    "request", req.submitted_at, t_pop, track=track,
+                    req_id=req.req_id, status="timeout", tokens=0,
+                )
                 self._results.append(GenerationResult(
                     req_id=req.req_id, tokens=[], status="timeout",
                     prompt_tokens=len(req.prompt),
@@ -267,10 +301,12 @@ class ContinuousBatchingScheduler:
             padded = jnp.asarray(  # host-ok: host list → device upload
                 [[self.pad_token] * pad + list(req.prompt)], jnp.int32
             )
+            t_pre0 = self.clock()
             first_dev, prefill_cache = self.prefill_fn(padded, jnp.int32(pad))
             # The admission-path sync: on the pipelined path this overlaps
             # the in-flight decode step dispatched before bookkeeping.
             first = host_sync.fetch_scalar(first_dev)
+            t_pre1 = self.clock()
             slot = self.pool.acquire()
             assert slot is not None  # guarded by free_count above
             self.pool.admit(slot, prefill_cache, pad)
@@ -283,7 +319,21 @@ class ContinuousBatchingScheduler:
                 request=req, slot=slot, tokens=[first],
                 token_times=[self.clock()], budget=budget,
             )
+            entry.admitted_at = self.clock()
             self._active[slot] = entry
+            if self.tracer.enabled:
+                self.tracer.record(
+                    "queue", req.submitted_at, t_pop, track=track,
+                    req_id=req.req_id,
+                )
+                self.tracer.record(
+                    "prefill", t_pre0, t_pre1, track=track,
+                    req_id=req.req_id, prompt_tokens=plen,
+                )
+                self.tracer.record(
+                    "admit", t_pop, entry.admitted_at, track=track,
+                    req_id=req.req_id, slot=slot,
+                )
             if first == req.stop_token or len(entry.tokens) >= budget:
                 self._finish(entry, "completed")
             else:
@@ -295,6 +345,7 @@ class ContinuousBatchingScheduler:
         """Launch one decode iteration (non-blocking) and swap the
         donated cache. ``prev_tokens`` is the previous step's device
         output or a host-built vector when no step is in flight."""
+        t0 = self.clock()
         S = self.pool.max_slots
         override_vals = np.full((S,), self.pad_token, np.int32)
         override_mask = np.zeros((S,), bool)
@@ -311,8 +362,12 @@ class ContinuousBatchingScheduler:
             active_mask, self.pool.pad,
         )
         self.pool.swap(new_cache)
+        dispatched_at = self.clock()
+        self.tracer.record(
+            "dispatch", t0, dispatched_at, lanes=len(lanes),
+        )
         return _Inflight(tokens=nxt, lanes=lanes,
-                         dispatched_at=self.clock())
+                         dispatched_at=dispatched_at)
 
     def _host_prev_tokens(self):
         """Previous-token vector built host-side — the cold-start path
@@ -342,6 +397,11 @@ class ContinuousBatchingScheduler:
         now = self.clock()
         if self.metrics is not None:
             self.metrics.record_overlap(now - inflight.dispatched_at)
+        # One span per decode ITERATION (dispatch → tokens on host) —
+        # exactly the dispatch_to_fetch overlap window, not per-token.
+        self.tracer.record(
+            "decode_step", inflight.dispatched_at, now, lanes=len(live),
+        )
         emitted = 0
         for (slot, entry), (_, tok) in zip(live, fetched):
             entry.tokens.append(tok)
@@ -393,10 +453,14 @@ class ContinuousBatchingScheduler:
         emitted = (
             self._step_pipelined() if self.pipeline else self._step_sync()
         )
+        t1 = self.clock()
+        self.tracer.record(
+            "sched_step", t0, t1, tokens=emitted, active=len(self._active),
+        )
         if self.metrics is not None:
             self.metrics.record_step(
                 queue_depth=len(self.queue), active=len(self._active),
-                tokens=emitted, step_seconds=self.clock() - t0,
+                tokens=emitted, step_seconds=t1 - t0,
             )
         return self._results[before:]
 

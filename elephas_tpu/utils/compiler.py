@@ -33,6 +33,29 @@ import jax
 logger = logging.getLogger("elephas_tpu")
 
 
+def note_retrace(program: str, **args) -> None:
+    """Record a (re)trace of a hot program on the global observability
+    layer: a ``retrace_total`` counter bump (plus a per-program counter)
+    and an instant ``compile/<program>`` event on the default tracer.
+
+    Call this from inside a jitted function's Python body — the body
+    only runs when XLA (re)traces it, so a surprise retrace (a silent
+    10× regression when it happens per step) becomes a visible counter
+    and a trace marker instead of nothing. The serving engine wires its
+    prefill/decode bodies through here; tests pin those at one trace
+    each.
+    """
+    from elephas_tpu import obs
+
+    registry = obs.default_registry()
+    registry.counter(
+        "retrace_total", help="hot-program (re)traces across the process"
+    ).inc()
+    registry.counter(f"retrace_total::{program}").inc()
+    obs.default_tracer().instant(f"compile/{program}", **args)
+    logger.debug("retrace: %s %s", program, args or "")
+
+
 def tpu_compiler_options() -> Optional[dict]:
     """Compiler options for jitting hot train/eval programs.
 
@@ -106,6 +129,8 @@ def autotune_compile_options(build, run, force, steps: int = 24, candidates=None
     """
     import time
 
+    from elephas_tpu import obs
+
     if candidates is None:
         candidates = autotune_candidates()
     if len(candidates) == 1:
@@ -113,9 +138,11 @@ def autotune_compile_options(build, run, force, steps: int = 24, candidates=None
         return label, opts, {}
     table = {}
     by_label = {}
+    tracer = obs.default_tracer()
     for label, opts in candidates:
-        fn = build(opts)
-        force(run(fn))  # compile + warm
+        with tracer.span(f"compile/autotune:{label}"):
+            fn = build(opts)
+            force(run(fn))  # compile + warm
         t0 = time.perf_counter()
         out = None
         for _ in range(steps):

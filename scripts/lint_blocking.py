@@ -16,10 +16,21 @@ host-conversion call outside the sanctioned module:
 - ``jax.device_get(...)``              (the raw transfer primitive)
 - ``.block_until_ready()`` / ``jax.block_until_ready(...)``
 
+A second rule guards the serving package's CLOCK DOMAIN: scheduler,
+metrics, and tracer all take an injectable ``clock=`` (tests drive them
+with fakes; spans are recorded retroactively with scheduler timestamps),
+so a raw ``time.time()`` / ``time.perf_counter()`` /
+``time.monotonic()`` call in serving code silently mixes wall domains —
+timestamps stop comparing against the injected clock's. Such calls are
+flagged; read the time through ``self.clock()`` instead. (Bare
+``time.monotonic`` as a default-argument VALUE is fine — only calls are
+flagged.)
+
 Escape hatch: a line whose source carries a ``# host-ok`` pragma is
 exempt — for conversions of values that PROVABLY never touched the
 device (caller-supplied python ints, numpy buffers already fetched
-through ``host_sync``). The pragma keeps every exemption greppable.
+through ``host_sync``), or host-only timing genuinely outside the
+scheduled path. The pragma keeps every exemption greppable.
 
 Wired into tier-1 via ``tests/test_lint_blocking.py``; also runnable
 standalone: ``python scripts/lint_blocking.py`` (exit 1 on violations).
@@ -35,6 +46,7 @@ from typing import List, NamedTuple
 PRAGMA = "host-ok"
 SANCTIONED = "host_sync.py"
 _NUMPY_NAMES = ("np", "numpy")
+_CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
 
 
 class Violation(NamedTuple):
@@ -44,6 +56,13 @@ class Violation(NamedTuple):
     line: str
 
     def __str__(self):
+        if self.call.startswith("time."):
+            return (
+                f"{self.path}:{self.lineno}: raw clock call `{self.call}` "
+                f"bypasses the injected serving clock (read `self.clock()`; "
+                f"`# {PRAGMA}` only for timing outside the scheduled path)"
+                f"\n    {self.line.strip()}"
+            )
         return (
             f"{self.path}:{self.lineno}: blocking host sync `{self.call}` "
             f"outside host_sync.py (add `# {PRAGMA}` only if the value "
@@ -62,6 +81,9 @@ def _call_name(node: ast.Call) -> str | None:
         if fn.attr in ("asarray", "array") and isinstance(fn.value, ast.Name) \
                 and fn.value.id in _NUMPY_NAMES:
             return f"{fn.value.id}.{fn.attr}"
+        if fn.attr in _CLOCK_ATTRS and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "time":
+            return f"time.{fn.attr}"
     return None
 
 

@@ -122,12 +122,14 @@ def bench_generate(compiled, batch: int, prompt_len: int, new_tokens: int,
 
 def bench_serving(compiled, max_slots: int, prompt_len: int,
                   new_tokens: int, requests: int,
-                  pipeline: bool = True) -> dict:
+                  pipeline: bool = True, tracer=None) -> dict:
     """Drive the InferenceEngine over a mixed-length workload: more
     requests than slots, staggered submits, so admission happens
     mid-decode (continuous batching) and slots get reused.
     ``pipeline=False`` runs the unpipelined reference scheduler — the
-    before/after pair is the pipelining speedup."""
+    before/after pair is the pipelining speedup. ``tracer``: an
+    ``obs.Tracer`` to record the run's span tree into (None = the
+    disabled default — the untraced baseline)."""
     import numpy as np
 
     from elephas_tpu.metrics import mfu
@@ -142,6 +144,7 @@ def bench_serving(compiled, max_slots: int, prompt_len: int,
         max_len=prompt_len + new_tokens + 1,
         queue_depth=max(requests, 1),
         pipeline=pipeline,
+        tracer=tracer,
     )
     # Warm all three compiled programs (prefill, slot admission, decode)
     # outside the timed region — bench_generate does the same with its
@@ -177,11 +180,67 @@ def bench_serving(compiled, max_slots: int, prompt_len: int,
         "ttft_s_avg": stats["ttft_s_avg"],
         "itl_s_avg": stats["itl_s_avg"],
         "dispatch_to_fetch_s_avg": stats["dispatch_to_fetch_s_avg"],
+        # Tail latencies from the ServingMetrics histograms: the SLO
+        # columns (means hide stall spikes).
+        **{
+            f"{base}_{p}": stats[f"{base}_{p}"]
+            for base in ("ttft_s", "itl_s", "dispatch_to_fetch_s")
+            for p in ("p50", "p95", "p99")
+        },
         "prefill_traces": stats["prefill_traces"],
         "decode_traces": stats["decode_traces"],
         "pool_admitted_total": stats["pool_admitted_total"],
         "all_completed": all(r.status == "completed" for r in results),
     }
+
+
+def bench_trace_overhead(compiled, max_slots: int, prompt_len: int,
+                         new_tokens: int, requests: int,
+                         rounds: int = 3, attempts: int = 3) -> dict:
+    """Guardrail: tracing must cost < 2% serving throughput.
+
+    The tracer's pitch is "leave it on in production", so the bench
+    enforces it: one DISCARDED warmup run (the first run after a compile
+    reads measurably fast — hot caches), then ``rounds`` traced/untraced
+    pairs whose within-pair order alternates (decorrelates drift —
+    thermal, page cache — from the arm), compared best-of-``rounds``
+    (the noise floor on shared CPU runners swamps a 2% signal in means).
+    Retries the whole measurement before the assert fires; a persistent
+    > 2% gap is a real regression in the record/instant hot path."""
+    from elephas_tpu.obs import Tracer
+
+    run = lambda tracer: bench_serving(  # noqa: E731
+        compiled, max_slots, prompt_len, new_tokens, requests,
+        pipeline=True, tracer=tracer,
+    )["tokens_per_sec"]
+    run(None)  # warmup, discarded
+    for attempt in range(attempts):
+        plain, traced = [], []
+        for r in range(rounds):
+            if r % 2 == 0:
+                plain.append(run(None))
+                traced.append(run(Tracer()))
+            else:
+                traced.append(run(Tracer()))
+                plain.append(run(None))
+        overhead = 1.0 - max(traced) / max(plain)
+        if overhead < 0.02:
+            break
+    rec = {
+        "mode": "serving_trace_overhead",
+        "rounds": rounds,
+        "attempts_used": attempt + 1,
+        "tokens_per_sec_untraced": max(plain),
+        "tokens_per_sec_traced": max(traced),
+        "overhead_pct": overhead * 100.0,
+        "within_2pct": overhead < 0.02,
+    }
+    assert rec["within_2pct"], (
+        f"tracing overhead {overhead * 100.0:.2f}% >= 2% after "
+        f"{attempts} attempts (traced {max(traced):.0f} vs untraced "
+        f"{max(plain):.0f} tok/s)"
+    )
+    return rec
 
 
 def main(argv=None) -> list:
@@ -201,6 +260,13 @@ def main(argv=None) -> list:
     parser.add_argument("--serve-out", type=str, default=None,
                         help="write the serving arms (before/after "
                              "pipelining) as their own JSON artifact")
+    parser.add_argument("--trace", type=str, default=None,
+                        help="record one traced pipelined serving run's "
+                             "span tree to this Chrome trace JSON, plus a "
+                             "trace_report.py summary next to it (.md)")
+    parser.add_argument("--no-overhead-check", action="store_true",
+                        help="skip the traced-vs-untraced < 2%% guardrail "
+                             "(6 extra serving runs)")
     args = parser.parse_args(argv)
 
     import jax
@@ -233,6 +299,31 @@ def main(argv=None) -> list:
         serving_records.append(rec)
         records.append(rec)
         print(json.dumps(rec))
+    if not args.no_overhead_check:
+        rec = bench_trace_overhead(
+            compiled, args.serving_slots, args.prompt_len, args.new,
+            args.serving_requests,
+        )
+        serving_records.append(rec)
+        records.append(rec)
+        print(json.dumps(rec))
+    if args.trace:
+        from elephas_tpu.obs import Tracer
+
+        import scripts.trace_report as trace_report
+
+        tracer = Tracer()
+        bench_serving(
+            compiled, args.serving_slots, args.prompt_len, args.new,
+            args.serving_requests, pipeline=True, tracer=tracer,
+        )
+        tracer.export_chrome(args.trace)
+        report_path = os.path.splitext(args.trace)[0] + ".md"
+        text = trace_report.report(args.trace)
+        with open(report_path, "w") as f:
+            f.write(text)
+        print(f"trace: {args.trace} (Perfetto-viewable); report: "
+              f"{report_path}")
     if args.out:
         with open(args.out, "w") as f:
             json.dump(records, f, indent=1)

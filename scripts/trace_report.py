@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Per-phase latency report over a Chrome ``trace_event`` JSON file.
+
+Reads the trace the obs tracer exports (``Tracer.export_chrome`` /
+``scripts/lm_bench.py --trace``) back into numbers a human can act on:
+
+- a per-phase table — count, p50/p90/p95/p99, mean, total wall — over
+  every duration ("X") event name. Percentiles here are EXACT (the file
+  holds every sample), unlike the registry's bucketed estimates, so
+  this is also the oracle the histogram tests pin against.
+- one reconstructed per-request span tree: the busiest ``req:<id>``
+  track's events nested by time containment — the submit→queue→admit
+  (prefill)→decode→finish lifecycle, as the scheduler recorded it.
+
+Usage: ``python scripts/trace_report.py TRACE.json [--tree-req ID]``
+(importable: ``report(path) -> str`` and ``main(argv)``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load_events(path: str) -> List[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def track_names(path: str) -> Dict[int, str]:
+    """tid → thread-name from the trace's "M" metadata events."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    return {
+        e["tid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Exact linear-interpolated quantile of an ASCENDING sample list."""
+    if not sorted_vals:
+        raise ValueError("empty sample list")
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * frac
+
+
+def phase_table(events: List[dict]) -> List[dict]:
+    """One row per span name: count + exact latency percentiles (s),
+    sorted by total wall descending."""
+    by_name: Dict[str, List[float]] = {}
+    for e in events:
+        if e.get("dur", 0) <= 0:
+            continue  # instants carry no duration signal
+        by_name.setdefault(e["name"], []).append(e["dur"] / 1e6)
+    rows = []
+    for name, vals in by_name.items():
+        vals.sort()
+        rows.append({
+            "phase": name,
+            "count": len(vals),
+            "p50_s": percentile(vals, 0.50),
+            "p90_s": percentile(vals, 0.90),
+            "p95_s": percentile(vals, 0.95),
+            "p99_s": percentile(vals, 0.99),
+            "mean_s": sum(vals) / len(vals),
+            "total_s": sum(vals),
+        })
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
+def build_tree(events: List[dict]) -> List[dict]:
+    """Nest one track's events by time containment: parent = the
+    innermost longer span whose [ts, ts+dur] covers the child's."""
+    nodes = [
+        {"event": e, "start": e["ts"], "end": e["ts"] + e.get("dur", 0),
+         "children": []}
+        for e in events
+    ]
+    # Outermost first: earlier start, then longer duration, so a stack
+    # walk assigns each node to the deepest still-open enclosing span.
+    nodes.sort(key=lambda n: (n["start"], -(n["end"] - n["start"])))
+    roots: List[dict] = []
+    stack: List[dict] = []
+    eps = 1.0  # µs slack: clock reads inside a span can tie its edges
+    for node in nodes:
+        while stack and node["start"] > stack[-1]["end"] + eps:
+            stack.pop()
+        while stack and node["end"] > stack[-1]["end"] + eps:
+            stack.pop()  # overlaps but not contained: not a child
+        (stack[-1]["children"] if stack else roots).append(node)
+        stack.append(node)
+    return roots
+
+
+def pick_request_track(events: List[dict], names: Dict[int, str],
+                       req_id: Optional[int] = None) -> Optional[int]:
+    """The tid to draw the sample tree from: the requested ``req:<id>``
+    track, else the busiest completed-request track."""
+    req_tids = {t for t, n in names.items() if n.startswith("req:")}
+    if req_id is not None:
+        want = f"req:{req_id}"
+        for tid, name in names.items():
+            if name == want:
+                return tid
+        return None
+    best, best_key = None, (-1, -1)
+    for tid in req_tids:
+        evs = [e for e in events if e["tid"] == tid]
+        done = any(
+            e["name"] == "request"
+            and (e.get("args") or {}).get("status") == "completed"
+            for e in evs
+        )
+        try:
+            rid = int(names[tid].split(":", 1)[1])
+        except ValueError:
+            rid = -1
+        # Tie-break toward the LATEST request: early ones carry XLA
+        # compile inside prefill and misrepresent steady state.
+        if done and (len(evs), rid) > best_key:
+            best, best_key = tid, (len(evs), rid)
+    return best
+
+
+def format_tree(roots: List[dict], indent: str = "") -> List[str]:
+    lines = []
+    for node in roots:
+        e = node["event"]
+        dur_ms = e.get("dur", 0) / 1e3
+        args = e.get("args") or {}
+        extra = " ".join(
+            f"{k}={v}" for k, v in args.items() if k != "req_id"
+        )
+        what = (
+            f"@{e['ts'] / 1e3:.3f}ms" if e.get("dur", 0) == 0
+            else f"{dur_ms:.3f}ms"
+        )
+        lines.append(f"{indent}{e['name']:<12} {what}"
+                     + (f"  [{extra}]" if extra else ""))
+        lines.extend(format_tree(node["children"], indent + "  "))
+    return lines
+
+
+def report(path: str, req_id: Optional[int] = None) -> str:
+    events = load_events(path)
+    names = track_names(path)
+    out = [f"# Trace report: {path}", ""]
+    if not events:
+        out.append("(no duration events)")
+        return "\n".join(out)
+    window_s = (
+        max(e["ts"] + e.get("dur", 0) for e in events)
+        - min(e["ts"] for e in events)
+    ) / 1e6
+    n_req = sum(1 for n in names.values() if n.startswith("req:"))
+    out.append(
+        f"{len(events)} span events over {window_s:.3f}s across "
+        f"{len(names)} tracks ({n_req} request lanes)"
+    )
+    out += ["", "## Per-phase latency (seconds, exact percentiles)", ""]
+    header = (f"{'phase':<22}{'count':>7}{'p50':>11}{'p90':>11}"
+              f"{'p95':>11}{'p99':>11}{'mean':>11}{'total':>11}")
+    out += [header, "-" * len(header)]
+    for r in phase_table(events):
+        out.append(
+            f"{r['phase']:<22}{r['count']:>7}"
+            f"{r['p50_s']:>11.6f}{r['p90_s']:>11.6f}{r['p95_s']:>11.6f}"
+            f"{r['p99_s']:>11.6f}{r['mean_s']:>11.6f}{r['total_s']:>11.4f}"
+        )
+    tid = pick_request_track(events, names, req_id)
+    if tid is not None:
+        out += ["", f"## Sample request lifecycle ({names[tid]})", ""]
+        tree = build_tree([e for e in events if e["tid"] == tid])
+        out.extend(format_tree(tree))
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> str:
+    parser = argparse.ArgumentParser(
+        description="Per-phase percentiles + request tree from a trace"
+    )
+    parser.add_argument("trace", help="Chrome trace_event JSON file")
+    parser.add_argument("--tree-req", type=int, default=None,
+                        help="draw the tree for this req_id")
+    parser.add_argument("--out", default=None,
+                        help="also write the report to this file")
+    args = parser.parse_args(argv)
+    text = report(args.trace, req_id=args.tree_req)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    print(text, end="")
+    return text
+
+
+if __name__ == "__main__":
+    main()

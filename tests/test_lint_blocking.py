@@ -53,6 +53,51 @@ def test_pragma_exempts_a_line(tmp_path):
     assert lint.lint_file(ok) == []
 
 
+def test_lint_catches_raw_clock_calls(tmp_path):
+    """Clock-domain rule: serving code must read the injected
+    ``self.clock()`` — raw ``time.*()`` CALLS split the span/trace time
+    domain from the fake-clock tests'."""
+    bad = tmp_path / "clocky.py"
+    bad.write_text(textwrap.dedent("""
+        import time
+
+        def f(self):
+            a = time.time()
+            b = time.perf_counter()
+            c = time.monotonic()
+            return a, b, c
+    """))
+    calls = {v.call for v in lint.lint_file(bad)}
+    assert calls == {"time.time", "time.perf_counter", "time.monotonic"}
+    msg = str(lint.lint_file(bad)[0])
+    assert "injected serving clock" in msg
+
+
+def test_clock_reference_is_not_a_call(tmp_path):
+    """Passing ``time.monotonic`` as a default clock VALUE is the
+    sanctioned idiom — only calling it inline is flagged."""
+    ok = tmp_path / "defaults.py"
+    ok.write_text(textwrap.dedent("""
+        import time
+
+        def make(clock=time.monotonic):
+            fallback = time.monotonic
+            time.sleep(0)
+            return clock, fallback
+    """))
+    assert lint.lint_file(ok) == []
+
+
+def test_pragma_exempts_a_clock_line(tmp_path):
+    ok = tmp_path / "ok_clock.py"
+    ok.write_text(
+        "import time\n"
+        "def f():\n"
+        "    return time.monotonic()  # host-ok: module-load timestamp\n"
+    )
+    assert lint.lint_file(ok) == []
+
+
 def test_host_sync_module_is_sanctioned(tmp_path):
     pkg = tmp_path / "serving"
     pkg.mkdir()

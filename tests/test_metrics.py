@@ -55,6 +55,47 @@ def test_host0_logger_singleton():
     logger.info("hello")  # no assertion — just must not raise
 
 
+def test_host0_logger_idempotent_on_nonzero_host(monkeypatch):
+    """Repeated calls on a non-zero host must not stack NullHandlers —
+    every module grabs its logger through here, and logging iterates
+    the handler list per record."""
+    import logging as py_logging
+
+    import jax
+
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    name = "elephas_test_nonzero_host"
+    for _ in range(3):
+        logger = host0_logger(name)
+    nulls = [h for h in logger.handlers
+             if isinstance(h, py_logging.NullHandler)]
+    assert len(nulls) == 1
+    assert logger.propagate is False
+
+
+def test_trace_opens_and_closes_profiler_window(monkeypatch):
+    """metrics.logging.trace = one jax.profiler window: start on enter,
+    stop on exit — including when the body raises."""
+    import jax
+
+    from elephas_tpu.metrics import logging as mlog
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda log_dir: calls.append(("start", log_dir)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop",)))
+    with mlog.trace("/tmp/tb"):
+        calls.append(("body",))
+    assert calls == [("start", "/tmp/tb"), ("body",), ("stop",)]
+
+    calls.clear()
+    with pytest.raises(RuntimeError):
+        with mlog.trace("/tmp/tb2"):
+            raise RuntimeError("boom")
+    assert calls == [("start", "/tmp/tb2"), ("stop",)]
+
+
 def test_tpu_compiler_options_gating(monkeypatch):
     """OPT-IN knob: None off-TPU and by default on TPU (the 96MiB bump
     regressed the LSTM fit 43% — utils/compiler.py A/B table); env

@@ -1,0 +1,329 @@
+"""Unified observability layer: span tracer, metrics registry, and the
+trace_report reader (ISSUE: tracing + metrics across serving/training/PS).
+
+The contracts pinned here are the ones instrumented code relies on:
+recording never allocates on the disabled path, the ring bounds memory
+by dropping the OLDEST events, Chrome export round-trips through
+``scripts/trace_report.py``, and the bucketed histogram's percentile
+estimates stay within one bucket of the exact quantile.
+"""
+
+import json
+
+import pytest
+
+from elephas_tpu import obs
+from elephas_tpu.obs import (
+    NULL_TRACER,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Tracer,
+)
+
+import scripts.trace_report as trace_report
+
+
+class FakeClock:
+    """Deterministic monotonic clock (same idiom as test_serving's)."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# -- tracer ----------------------------------------------------------------
+
+
+def test_span_records_with_clock():
+    clock = FakeClock()
+    tr = Tracer(clock=clock, annotate_device=False)
+    with tr.span("phase", req_id=3):
+        clock.advance(0.25)
+    (e,) = tr.events()
+    assert e.name == "phase" and e.duration_s == pytest.approx(0.25)
+    assert e.args == {"req_id": 3}
+
+
+def test_ring_drops_oldest():
+    tr = Tracer(capacity=4, clock=FakeClock(), annotate_device=False)
+    for i in range(10):
+        tr.record(f"e{i}", float(i), float(i) + 0.5)
+    names = [e.name for e in tr.events()]
+    assert names == ["e6", "e7", "e8", "e9"]  # oldest 6 dropped
+    assert len(tr) == 4
+    tr.clear()
+    assert len(tr) == 0
+
+
+def test_disabled_tracer_is_free():
+    tr = Tracer(enabled=False, annotate_device=False)
+    # The disabled span() must not allocate: one shared null context.
+    assert tr.span("a") is tr.span("b", x=1)
+    with tr.span("a"):
+        pass
+    tr.record("r", 0.0, 1.0)
+    tr.instant("i")
+    assert len(tr) == 0
+    assert NULL_TRACER.span("x") is tr.span("y")  # module-wide singleton
+
+
+def test_default_tracer_enable_disable():
+    assert obs.default_tracer() is NULL_TRACER
+    try:
+        live = obs.enable_tracing(capacity=16, annotate_device=False)
+        assert obs.default_tracer() is live and live.enabled
+    finally:
+        obs.disable_tracing()
+    assert obs.default_tracer() is NULL_TRACER
+
+
+def test_chrome_export_tracks_and_normalization(tmp_path):
+    clock = FakeClock(50.0)
+    tr = Tracer(clock=clock, annotate_device=False)
+    tr.record("queue", 50.0, 50.1, track="req:1", req_id=1)
+    tr.record("request", 50.0, 50.5, track="req:1", req_id=1,
+              status="completed")
+    tr.record("sched_step", 50.2, 50.3)  # untracked -> thread row
+    path = tmp_path / "t.json"
+    doc = tr.export_chrome(str(path))
+    assert json.load(open(path)) == doc
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    # Two rows: the request lane and the recording thread's lane.
+    assert {m["args"]["name"] for m in metas} >= {"req:1"}
+    assert len({m["tid"] for m in metas}) == 2
+    # Earliest event normalized to ts=0, µs units.
+    queue = next(e for e in xs if e["name"] == "queue")
+    assert queue["ts"] == pytest.approx(0.0)
+    assert queue["dur"] == pytest.approx(0.1e6)
+    req = next(e for e in xs if e["name"] == "request")
+    assert req["args"]["status"] == "completed"
+    # Same tid => Perfetto nests queue inside request by containment.
+    assert req["tid"] == queue["tid"]
+
+
+def test_instant_is_zero_duration():
+    tr = Tracer(clock=FakeClock(7.0), annotate_device=False)
+    tr.instant("finish", track="req:2", status="completed")
+    (e,) = tr.events()
+    assert e.begin_s == e.end_s == 7.0
+    (ev,) = [x for x in tr.to_chrome_events() if x["ph"] == "X"]
+    assert ev["dur"] == 0.0
+
+
+def test_span_device_annotation_degrades_without_profiler():
+    """The TraceAnnotation bridge degrades to plain host spans when the
+    annotation constructor blows up (stripped / jax-less environment)."""
+
+    class Boom:
+        def __init__(self, name):
+            raise RuntimeError("no profiler here")
+
+    tr = Tracer(clock=FakeClock(), annotate_device=True)
+    tr._annotation_cls = Boom
+    with tr.span("ok"):
+        pass
+    assert len(tr) == 1
+    assert tr._annotate is False  # bridge disabled after first failure
+
+
+# -- registry --------------------------------------------------------------
+
+
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs", help="requests")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert reg.counter("reqs") is c  # get-or-create is idempotent
+    g = reg.gauge("depth")
+    g.set(3)
+    assert g.value == 3.0
+    with pytest.raises(TypeError):
+        reg.gauge("reqs")  # kind mismatch fails loudly
+
+
+def test_histogram_percentiles_track_exact():
+    """Bucketed estimate vs exact quantile on a known distribution:
+    the estimate must land within the owning bucket (here: linear 1ms
+    buckets over 1..100ms, so within 1ms of exact)."""
+    vals = [i / 1000.0 for i in range(1, 101)]  # 1ms .. 100ms
+    h = Histogram("lat", buckets=[i / 1000.0 for i in range(1, 101)])
+    for v in vals:
+        h.observe(v)
+    vals.sort()
+    for q in (0.5, 0.9, 0.95, 0.99):
+        exact = trace_report.percentile(vals, q)
+        assert h.percentile(q) == pytest.approx(exact, abs=1.5e-3), q
+    assert h.count == 100
+    assert h.mean == pytest.approx(sum(vals) / 100)
+    assert h.min == 0.001 and h.max == 0.1
+
+
+def test_histogram_degenerate_and_overflow():
+    h = Histogram("h", buckets=[1.0, 2.0])
+    assert h.percentile(0.5) is None  # empty
+    h.observe(5.0)  # overflow bucket
+    assert h.percentile(0.5) == 5.0  # clamped to observed max
+    h2 = Histogram("h2", buckets=[1.0])
+    for _ in range(10):
+        h2.observe(0.5)
+    # Single repeated value: every percentile is that value.
+    assert h2.percentile(0.01) == 0.5 and h2.percentile(0.99) == 0.5
+    with pytest.raises(ValueError):
+        h2.percentile(1.5)
+
+
+def test_expose_text_prometheus_shape():
+    reg = MetricsRegistry()
+    reg.counter("retrace_total", help="hot retraces").inc(2)
+    h = reg.histogram("step_s", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    text = reg.expose_text()
+    assert "# HELP retrace_total hot retraces" in text
+    assert "# TYPE retrace_total counter" in text
+    assert "retrace_total 2" in text
+    assert 'step_s_bucket{le="0.1"} 1' in text
+    assert 'step_s_bucket{le="1"} 2' in text  # cumulative
+    assert 'step_s_bucket{le="+Inf"} 2' in text
+    assert "step_s_count 2" in text
+
+
+def test_registry_snapshot_and_jsonl_bridge(tmp_path):
+    from elephas_tpu.metrics import JsonlSink
+
+    reg = MetricsRegistry()
+    reg.counter("pushes").inc(3)
+    h = reg.histogram("ttft_s", buckets=[0.01, 0.1, 1.0])
+    h.observe(0.05)
+    snap = reg.snapshot()
+    assert snap["pushes"] == 3
+    assert snap["ttft_s_count"] == 1 and "ttft_s_p99" in snap
+    path = str(tmp_path / "m.jsonl")
+    with JsonlSink(path) as sink:
+        reg.log_to(sink, step=7, run="bench")
+    rec = json.loads(open(path).read())
+    assert rec["step"] == 7 and rec["event"] == "metrics"
+    assert rec["pushes"] == 3 and rec["run"] == "bench"
+
+
+def test_note_retrace_counts_and_marks():
+    from elephas_tpu.utils.compiler import note_retrace
+
+    reg = obs.default_registry()
+    before = reg.counter("retrace_total").value
+    tr = obs.enable_tracing(capacity=8, annotate_device=False)
+    try:
+        note_retrace("unit_test_prog", count=1)
+    finally:
+        obs.disable_tracing()
+    assert reg.counter("retrace_total").value == before + 1
+    assert reg.counter("retrace_total::unit_test_prog").value >= 1
+    assert any(e.name == "compile/unit_test_prog" for e in tr.events())
+
+
+# -- trace_report ----------------------------------------------------------
+
+
+def _synthetic_trace(tmp_path):
+    """A hand-built request lifecycle the scheduler would record."""
+    clock = FakeClock(10.0)
+    tr = Tracer(clock=clock, annotate_device=False)
+    t = 10.0
+    tr.instant("submit", at=t, track="req:5", req_id=5)
+    tr.record("queue", t, t + 0.010, track="req:5", req_id=5)
+    tr.record("prefill", t + 0.011, t + 0.030, track="req:5", req_id=5)
+    tr.record("admit", t + 0.010, t + 0.032, track="req:5", req_id=5)
+    tr.record("decode", t + 0.032, t + 0.090, track="req:5", req_id=5,
+              tokens=8)
+    tr.instant("finish", at=t + 0.091, track="req:5", req_id=5,
+               status="completed")
+    tr.record("request", t, t + 0.091, track="req:5", req_id=5,
+              status="completed", tokens=8)
+    for i in range(20):
+        tr.record("decode_step", t + i * 0.004, t + i * 0.004 + 0.003)
+    path = str(tmp_path / "trace.json")
+    tr.export_chrome(path)
+    return path
+
+
+def test_trace_report_phase_table(tmp_path):
+    path = _synthetic_trace(tmp_path)
+    events = trace_report.load_events(path)
+    rows = {r["phase"]: r for r in trace_report.phase_table(events)}
+    assert rows["decode_step"]["count"] == 20
+    assert rows["decode_step"]["p50_s"] == pytest.approx(0.003, rel=1e-3)
+    assert rows["queue"]["count"] == 1
+    # Instants (submit/finish) carry no duration -> excluded.
+    assert "submit" not in rows and "finish" not in rows
+
+
+def test_trace_report_request_tree(tmp_path):
+    path = _synthetic_trace(tmp_path)
+    text = trace_report.report(path, req_id=5)
+    assert "## Sample request lifecycle (req:5)" in text
+    # Only the tree section — the phase table lists the same names.
+    tree = text.split("## Sample request lifecycle")[1].splitlines()
+
+    def line_of(phase):
+        return next(i for i, l in enumerate(tree)
+                    if l.strip().split()[:1] == [phase])
+
+    def indent_of(i):
+        return len(tree[i]) - len(tree[i].lstrip())
+
+    req, adm, pre = line_of("request"), line_of("admit"), line_of("prefill")
+    dec, fin = line_of("decode"), line_of("finish")
+    # Containment: request wraps the lifecycle; prefill nests inside
+    # admit; decode and the finish instant sit directly under request.
+    assert req < line_of("queue") < adm < pre < dec < fin
+    assert indent_of(req) < indent_of(adm) < indent_of(pre)
+    assert indent_of(dec) == indent_of(adm) == indent_of(fin)
+
+
+def test_trace_report_exact_percentile():
+    vals = sorted(float(i) for i in range(1, 101))
+    assert trace_report.percentile(vals, 0.0) == 1.0
+    assert trace_report.percentile(vals, 1.0) == 100.0
+    assert trace_report.percentile(vals, 0.5) == pytest.approx(50.5)
+    assert trace_report.percentile([3.0], 0.9) == 3.0
+    with pytest.raises(ValueError):
+        trace_report.percentile([], 0.5)
+
+
+# -- serving metrics percentiles -------------------------------------------
+
+
+def test_serving_metrics_percentiles():
+    from elephas_tpu.serving.metrics import ServingMetrics
+    from elephas_tpu.serving.scheduler import GenerationResult
+
+    m = ServingMetrics(clock=FakeClock())
+    m.record_submit()
+    for i in range(1, 21):
+        m.record_finish(
+            GenerationResult(
+                req_id=i, tokens=[1], status="completed", prompt_tokens=1,
+                ttft_s=i / 100.0, itl_s_avg=i / 1000.0,
+            ),
+            queue_depth=0, active=1,
+        )
+        m.record_overlap(i / 500.0)
+    s = m.summary()
+    for base in ("ttft_s", "itl_s", "dispatch_to_fetch_s"):
+        assert s[f"{base}_p50"] is not None
+        assert s[f"{base}_p50"] <= s[f"{base}_p95"] <= s[f"{base}_p99"]
+    # p50 near the exact median (bucketed estimate, geometric ladder).
+    assert s["ttft_s_p50"] == pytest.approx(0.105, rel=0.5)
+    m.reset()
+    assert m.summary()["ttft_s_p50"] is None
