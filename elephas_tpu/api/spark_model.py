@@ -69,6 +69,7 @@ class TpuModel:
         hogwild_granularity: str = "tree",
         max_failures: int = 4,
         autotune: bool = False,
+        pipelined_comms: Optional[bool] = None,
     ):
         """``hogwild_granularity`` ('tree'|'leaf'): lock-free apply
         isolation for mode='hogwild' — 'leaf' drops at most racing
@@ -90,7 +91,13 @@ class TpuModel:
         measured scoped-VMEM knob, utils/compiler.py) and the winner
         compiles the fit's hot programs. The choice lands in history as
         ``compile_autotune``. Off-TPU (or with $ELEPHAS_SCOPED_VMEM_KIB
-        forcing a choice) this is a no-op."""
+        forcing a choice) this is a no-op.
+
+        ``pipelined_comms``: async/hogwild only — move each worker's
+        parameter-server traffic onto a background comms thread (pushes
+        fire-and-forget with bounded backpressure, next pull prefetched
+        while the unit trains). Default None = on for the http/socket
+        transports, off for 'local'; see ``AsyncTrainer``."""
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         if frequency not in FREQUENCIES:
@@ -129,6 +136,7 @@ class TpuModel:
         self.port = port
         self.custom_objects = custom_objects or {}
         self.batch_size = batch_size
+        self.pipelined_comms = pipelined_comms
 
         n_devices = len(jax.devices())
         if num_workers is None:
@@ -277,6 +285,7 @@ class TpuModel:
                 max_failures=self.max_failures,
                 autotune=self.autotune,
                 stream_batches=stream_batches,
+                pipelined_comms=self.pipelined_comms,
             )
             state, history = trainer.fit(
                 dataset,

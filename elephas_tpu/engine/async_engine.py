@@ -22,6 +22,7 @@ reference, where the driver averages weights, never optimizer slots).
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 from collections import deque
@@ -53,6 +54,177 @@ def _probe_sum(leaves):
     )
 
 
+class _PullBox:
+    """One in-flight prefetched pull: the comms thread fills exactly one
+    of value/error, then sets the event."""
+
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+        self.error = None
+
+
+class _CommsPipeline:
+    """Per-worker background comms thread: pushes become bounded
+    fire-and-forget, pulls become prefetches.
+
+    One FIFO queue, one thread — so deltas are applied in the order the
+    worker produced them, and a prefetched pull is ordered exactly where
+    the worker enqueued it relative to its pushes. The queue is bounded
+    (``maxsize=3``): a worker outrunning the wire blocks in ``push()``
+    (backpressure) instead of growing an unbounded backlog of
+    model-sized deltas.
+
+    Failure contract (mirrors ``run_unit``'s, shifted off-thread):
+
+    - ``ParameterServerUnavailable`` is infrastructure death — recorded
+      as fatal, never retried; the worker's NEXT pipeline op re-raises
+      it, preserving the fail-fast bound (pull waiters get it
+      immediately via their box).
+    - A transient push failure retries the SAME delta up to
+      ``max_failures`` total attempts (counted in ``ps_push_retry_total``).
+      This is the engine layer's documented at-least-once: the wire
+      client never re-sends an in-flight write, but the failed attempt
+      may have applied server-side, so the re-push can double-apply —
+      benign for SGD, same noise class as ``run_unit``'s unit-level
+      re-push (see its docstring).
+    - Pull failures are NOT retried here — they surface to the waiting
+      worker, whose ``run_unit`` owns unit-level retry exactly as on
+      the serial path.
+    - After a fatal, the thread short-circuits the remaining queue
+      (pushes complete without wire ops, pull boxes get the fatal) so
+      ``flush``/``close`` never deadlock behind a dead server.
+
+    ``flush()`` waits for every enqueued push to complete — called at
+    each epoch boundary BEFORE ``on_epoch_done`` so the barrier snapshot
+    (validation/checkpoint) sees all of this worker's epoch pushes; it
+    deliberately does not wait on a pending prefetch.
+    """
+
+    def __init__(self, client, worker_index: int, max_push_attempts: int):
+        self._client = client
+        self._max_push_attempts = max(1, max_push_attempts)
+        self._queue: queue.Queue = queue.Queue(maxsize=3)
+        self._fatal: Optional[BaseException] = None
+        self._pending: Optional[_PullBox] = None
+        self._push_cond = threading.Condition()
+        self._pushes_enqueued = 0
+        self._pushes_done = 0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"worker{worker_index}-comms"
+        )
+        self._thread.start()
+
+    # -- worker-side API ------------------------------------------------
+
+    def prefetch(self) -> None:
+        """Schedule the next pull now so it rides the wire while the
+        worker trains; no-op if one is already pending or we're dead."""
+        if self._fatal is not None or self._pending is not None:
+            return
+        box = _PullBox()
+        self._pending = box
+        self._put(("pull", box))
+
+    def pull(self):
+        """Consume the pending prefetch (or issue a synchronous pull),
+        blocking until the params arrive."""
+        self._raise_if_fatal()
+        box, self._pending = self._pending, None
+        if box is None:
+            box = _PullBox()
+            self._put(("pull", box))
+        box.event.wait()
+        if box.error is not None:
+            raise box.error
+        return box.value
+
+    def push(self, delta) -> None:
+        """Fire-and-forget enqueue; blocks only when the bounded queue is
+        full (backpressure) or re-raises a recorded fatal."""
+        self._raise_if_fatal()
+        with self._push_cond:
+            self._pushes_enqueued += 1
+        self._put(("push", delta))
+
+    def flush(self) -> None:
+        with self._push_cond:
+            while self._pushes_done < self._pushes_enqueued:
+                self._push_cond.wait(0.05)
+        self._raise_if_fatal()
+
+    def close(self) -> None:
+        """Stop and join the comms thread (idempotent). Call BEFORE
+        closing the client — a stray prefetch otherwise races the close."""
+        if self._thread is None:
+            return
+        self._put(("stop", None))
+        self._thread.join()
+        self._thread = None
+
+    # -- comms thread ---------------------------------------------------
+
+    def _raise_if_fatal(self) -> None:
+        if self._fatal is not None:
+            raise self._fatal
+
+    def _put(self, item) -> None:
+        # Bounded put that can't wedge: after a fatal the thread drains
+        # the queue without wire ops, so the timeout loop always exits.
+        while True:
+            try:
+                self._queue.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                continue
+
+    def _loop(self) -> None:
+        while True:
+            kind, payload = self._queue.get()
+            if kind == "stop":
+                return
+            if kind == "pull":
+                box = payload
+                if self._fatal is not None:
+                    box.error = self._fatal
+                    box.event.set()
+                    continue
+                try:
+                    box.value = self._client.get_parameters()
+                except BaseException as exc:
+                    box.error = exc
+                    if isinstance(exc, ParameterServerUnavailable):
+                        self._fatal = exc
+                box.event.set()
+            else:  # push
+                try:
+                    if self._fatal is None:
+                        self._push_with_retry(payload)
+                finally:
+                    with self._push_cond:
+                        self._pushes_done += 1
+                        self._push_cond.notify_all()
+
+    def _push_with_retry(self, delta) -> None:
+        for attempt in range(self._max_push_attempts):
+            try:
+                self._client.update_parameters(delta)
+                return
+            except ParameterServerUnavailable as exc:
+                self._fatal = exc  # fail-fast contract: never retried
+                return
+            except Exception as exc:
+                if attempt + 1 >= self._max_push_attempts:
+                    self._fatal = exc
+                    return
+                obs.default_registry().counter(
+                    "ps_push_retry_total",
+                    help="background same-delta push retries (pipelined comms)",
+                ).inc()
+
+
 class AsyncTrainer:
     def __init__(
         self,
@@ -66,8 +238,22 @@ class AsyncTrainer:
         max_failures: int = 4,
         autotune: bool = False,
         stream_batches: Optional[int] = None,
+        pipelined_comms: Optional[bool] = None,
     ):
-        """``granularity`` ('tree'|'leaf'): hogwild apply isolation —
+        """``pipelined_comms``: run each worker's PS traffic on a
+        background comms thread (``_CommsPipeline``) — pushes become
+        bounded fire-and-forget, and the next unit's pull prefetches
+        while the current one trains ('batch' frequency; 'epoch'
+        prefetches after the push so an epoch pull always sees the
+        worker's own epoch). Default (None) enables it for the wire
+        transports (http/socket), where a round-trip costs real wall
+        time, and disables it for 'local', where a pull is a device
+        handle copy and the extra thread is pure overhead. At 'batch'
+        frequency the prefetched pull can miss the worker's own
+        just-pushed delta (one unit of self-staleness — standard
+        Downpour staleness, traded for full wire/compute overlap).
+
+        ``granularity`` ('tree'|'leaf'): hogwild apply isolation —
         'leaf' drops at most racing leaves instead of whole deltas at the
         cost of one dispatch per leaf per push (ParameterBuffer note).
 
@@ -112,6 +298,7 @@ class AsyncTrainer:
         if stream_batches is not None and stream_batches < 1:
             raise ValueError(f"stream_batches must be >= 1, got {stream_batches}")
         self.stream_batches = stream_batches
+        self.pipelined_comms = pipelined_comms
         # Phase profiling (scripts/flagship_phases.py): when True, the
         # 'epoch'-frequency worker loop and the epoch fire force device
         # results at phase boundaries and append per-phase wall seconds
@@ -802,6 +989,41 @@ class AsyncTrainer:
         usable = nb * batch_size
         x, y = np.asarray(x[:usable]), np.asarray(y[:usable])
 
+        # Pipelined comms (wire transports by default): PS traffic moves
+        # to a background thread so the worker never blocks on the wire
+        # in steady state. The finally joins the thread on EVERY exit —
+        # including a failed unit — so a dying worker can't leak a comms
+        # thread still holding its client.
+        pipelined = (
+            self.pipelined_comms
+            if self.pipelined_comms is not None
+            else self.parameter_server_mode != "local"
+        )
+        comms = _CommsPipeline(client, index, self.max_failures) if pipelined else None
+        try:
+            return self._run_worker_units(
+                index, device, client, comms, x, y, nb, usable,
+                epochs, batch_size, on_epoch_done,
+            )
+        finally:
+            if comms is not None:
+                comms.close()
+
+    def _run_worker_units(
+        self,
+        index: int,
+        device: jax.Device,
+        client,
+        comms: Optional[_CommsPipeline],
+        x,
+        y,
+        nb: int,
+        usable: int,
+        epochs: int,
+        batch_size: int,
+        on_epoch_done=None,
+    ) -> List[Dict[str, float]]:
+        compiled = self.compiled
         opt_state = None
         epoch_metrics: List[Dict[str, float]] = []
         # Worker threads each get their own tid row in the trace (events
@@ -811,8 +1033,18 @@ class AsyncTrainer:
 
         def pull_state(step: int, attempt: int = 0) -> TrainState:
             nonlocal opt_state
+            # Pipelined: async/pull now measures how long the worker
+            # WAITED for params (near zero once the prefetch is warm);
+            # the wire time itself lands on the comms thread's ps/pull
+            # lane in the trace.
             with tracer.span("async/pull", worker=index, step=step):
-                pulled = client.get_parameters()
+                pulled = comms.pull() if comms is not None else client.get_parameters()
+                if comms is not None and self.frequency == "batch":
+                    # Double-buffered: the NEXT unit's pull rides the
+                    # wire while this unit trains. It can miss this
+                    # unit's own push (one unit of self-staleness — see
+                    # the pipelined_comms docstring).
+                    comms.prefetch()
                 params = jax.device_put(pulled["params"], device)
                 batch_stats = jax.device_put(pulled["batch_stats"], device)
                 if opt_state is None:
@@ -840,7 +1072,17 @@ class AsyncTrainer:
                         before.batch_stats, after.batch_stats
                     ),
                 }
-                client.update_parameters(delta)
+                if comms is None:
+                    client.update_parameters(delta)
+                    return
+                comms.push(delta)  # fire-and-forget, bounded backpressure
+                if self.frequency == "epoch":
+                    # Epoch pulls prefetch AFTER the push so the next
+                    # epoch's base always includes this worker's own
+                    # epoch (a whole epoch of self-staleness would be
+                    # too costly); the pull then overlaps the metric
+                    # fetch + epoch-barrier work instead of training.
+                    comms.prefetch()
 
         def run_unit(unit):
             """Spark's ``spark.task.maxFailures`` analogue (SURVEY.md §5.3):
@@ -891,12 +1133,25 @@ class AsyncTrainer:
         # shape, barrier callback, client close) must never diverge
         # between them.
         def finish_epoch(entry: Dict[str, float], epoch: int) -> None:
+            if comms is not None:
+                # All of this worker's epoch pushes must be SERVER-SIDE
+                # before the barrier counts the epoch done — the barrier
+                # snapshot feeds validation/checkpointing, and an honest
+                # per-epoch val row must include the work it reports.
+                # Waits on pushes only, never the prefetched pull.
+                comms.flush()
             entry["_retries"] = float(epoch_retries)
             epoch_metrics.append(entry)
             if on_epoch_done is not None:
                 on_epoch_done(epoch)
 
         def finish_worker() -> List[Dict[str, float]]:
+            if comms is not None:
+                # Join the comms thread BEFORE closing the client — a
+                # stray prefetch (epoch mode enqueues one after the
+                # final push) must not race the close. Idempotent; the
+                # _run_worker finally covers error exits.
+                comms.close()
             if hasattr(client, "close"):
                 client.close()
             return epoch_metrics

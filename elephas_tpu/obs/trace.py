@@ -96,6 +96,17 @@ class _Span:
         self._begin = tracer.clock()
         return self
 
+    def note(self, **attrs) -> "_Span":
+        """Attach args discovered mid-span (payload bytes, codec, cache
+        hit) — merged into the event's ``args`` at exit. Callers using
+        ``with tracer.span(...) as sp:`` must guard for a disabled
+        tracer, whose null context yields ``None``."""
+        if self._args is None:
+            self._args = dict(attrs)
+        else:
+            self._args.update(attrs)
+        return self
+
     def __exit__(self, *exc):
         tracer = self._tracer
         end = tracer.clock()

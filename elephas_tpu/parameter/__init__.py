@@ -12,9 +12,16 @@ adds). Transports are pluggable on top for cross-host parity:
   off-device except the pull into each worker chip),
 - ``http``   — stdlib ThreadingHTTPServer speaking the reference's
   GET /parameters, POST /update protocol,
-- ``socket`` — length-prefixed pickle frames with the reference's
+- ``socket`` — length-prefixed frames with the reference's
   ``'g'``/``'u'`` message kinds.
+
+Wire payloads default to the packed zero-copy codec (``wire.py``:
+contiguous tensor region + small JSON header, version-gated not-modified
+replies, optional bf16/f16 delta quantization) with magic-byte
+negotiation back to the reference's pickle for legacy peers.
 """
+
+from elephas_tpu.parameter import wire  # noqa: F401
 
 from elephas_tpu.parameter.base import (  # noqa: F401
     BaseParameterClient,

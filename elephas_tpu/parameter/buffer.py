@@ -114,9 +114,35 @@ class ParameterBuffer:
                 )
             return self._params
 
+    def get_with_version(self):
+        """``(version, snapshot)`` for the servers' version-gated cache.
+
+        The version is read BEFORE the snapshot (both under the read
+        lock; the ordering matters for ``lock=False``/hogwild where the
+        read lock is a no-op): a racing ``apply_delta`` can only make
+        the snapshot NEWER than the reported version, so a cache keyed
+        on it re-encodes at worst — it can never hand out a stale
+        not-modified reply for content the client hasn't seen."""
+        with self._lock.reading():
+            version = self._version
+            if self._granularity == "leaf":
+                treedef, paths, store = self._leaf_state
+                snap = jax.tree_util.tree_unflatten(
+                    treedef, [store[p] for p in paths]
+                )
+            else:
+                snap = self._params
+        return version, snap
+
     def get_numpy(self):
         """Host copy (for HTTP/socket transports)."""
         return jax.device_get(self.get())
+
+    def get_numpy_with_version(self):
+        """``(version, host-copy snapshot)``; device fetch happens AFTER
+        the read lock is released (see ``get_with_version``)."""
+        version, snap = self.get_with_version()
+        return version, jax.device_get(snap)
 
     def apply_delta(self, delta) -> None:
         """``weights -= delta`` on-device (reference update convention)."""
@@ -126,8 +152,12 @@ class ParameterBuffer:
                 self._params = self._apply(self._params, delta)
             else:
                 self._apply_per_leaf(delta)
-        with self._version_guard:
-            self._version += 1
+            # Version must move INSIDE the write lock: bumping after
+            # release would let a reader observe the new content under
+            # the old version and cache it — every later pull at the
+            # real version would then get a stale "not modified".
+            with self._version_guard:
+                self._version += 1
 
     def _apply_per_leaf(self, delta) -> None:
         """One read-modify-write per leaf SLOT: under NullLock a
@@ -148,3 +178,7 @@ class ParameterBuffer:
                 self._leaf_state = self._build_leaf_state(params)
             else:
                 self._params = params
+            # set() replaces content, so it must invalidate
+            # version-keyed snapshot caches exactly like apply_delta.
+            with self._version_guard:
+                self._version += 1

@@ -30,7 +30,6 @@ from __future__ import annotations
 import hmac
 import http.client
 import os
-import pickle
 import socket
 import threading
 import time
@@ -39,6 +38,7 @@ from typing import Optional
 import jax
 
 from elephas_tpu import obs
+from elephas_tpu.parameter import wire
 from elephas_tpu.parameter.base import BaseParameterClient
 from elephas_tpu.parameter.buffer import ParameterBuffer
 from elephas_tpu.utils import sockets as socket_utils
@@ -51,9 +51,73 @@ _CONNECT_TIMEOUT = 2.0  # dial budget per attempt (transfers get self.timeout)
 def _ps_span(op: str, transport: str):
     """Span + counter for one PS round-trip; every client's pull/push
     funnels through here so ``ps/pull``/``ps/push`` rows mean the same
-    thing across local, http, and socket transports."""
+    thing across local, http, and socket transports. The wire clients
+    ``note()`` payload bytes + codec onto the span (None-guarded: a
+    disabled tracer yields None)."""
     obs.default_registry().counter(f"ps_{op}_total").inc()
     return obs.default_tracer().span(f"ps/{op}", transport=transport)
+
+
+def _resolve_codec(codec: Optional[str]) -> str:
+    """Wire codec for this client: explicit arg > ``$ELEPHAS_PS_CODEC`` >
+    packed. ``'pickle'`` is the legacy-interop escape hatch — REQUIRED
+    when a SocketClient dials a pre-packed-codec server (the old socket
+    server closes the connection on unknown frame kinds; the HTTP
+    transport degrades transparently because responses self-describe by
+    magic, but pinning 'pickle' avoids shipping packed pushes it would
+    reject)."""
+    codec = codec or os.environ.get("ELEPHAS_PS_CODEC", "packed")
+    if codec not in ("packed", "pickle"):
+        raise ValueError(f"codec must be packed|pickle, got {codec!r}")
+    return codec
+
+
+def _encode_push(delta, codec: str, quantize: Optional[str]):
+    """``(payload, codec_used)`` for one push. Structures the packed
+    skeleton can't carry (custom pytree nodes) fall back to pickle —
+    the server accepts either on one endpoint."""
+    if codec == "packed":
+        try:
+            return wire.encode_tree(delta, quantize=quantize), "packed"
+        except wire.WireFormatError:
+            pass
+    return wire.encode_pickle(delta), "pickle"
+
+
+class _PullCache:
+    """Client side of the version-gated pull: remembers the last
+    ``(version, tree)`` a full-body reply carried, advertises the
+    version on the next pull, and resolves a not-modified reply back to
+    the cached tree. Thread-safe (the pipelined engine pulls from a
+    comms thread)."""
+
+    __slots__ = ("_lock", "_version", "_tree")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._version = None
+        self._tree = None
+
+    def known_version(self):
+        with self._lock:
+            return self._version if self._tree is not None else None
+
+    def store(self, version, tree):
+        if version is None:
+            return
+        with self._lock:
+            self._version, self._tree = version, tree
+
+    def resolve(self, not_modified: "wire.NotModified"):
+        with self._lock:
+            version, tree = self._version, self._tree
+        if tree is None or not_modified.version != version:
+            raise RuntimeError(
+                "parameter server sent not-modified for version "
+                f"{not_modified.version} but this client last saw "
+                f"{version} (protocol violation)"
+            )
+        return tree
 
 
 class ParameterServerUnavailable(ConnectionError):
@@ -149,12 +213,23 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
     """
 
     def __init__(self, master_url: str, timeout: float = 60.0,
-                 auth_key: Optional[bytes] = None):
+                 auth_key: Optional[bytes] = None,
+                 codec: Optional[str] = None,
+                 push_quantize: Optional[str] = None):
+        """``codec``: 'packed' (default) or 'pickle' (see
+        ``_resolve_codec``); responses self-describe by magic, so a
+        packed client degrades transparently against a legacy pickle
+        server. ``push_quantize``: 'bf16'|'f16' halves push bytes by
+        casting float deltas on the wire (lossy — see the README
+        convergence caveat; pulls are always full precision)."""
         host, port = master_url.rsplit(":", 1)
         self.master_url = master_url
         self._addr = (host, int(port))
         self.timeout = timeout
         self.auth_key = auth_key  # HMAC secret; see HttpServer auth docs
+        self.codec = _resolve_codec(codec)
+        self.push_quantize = push_quantize
+        self._pull_cache = _PullCache()
 
     def _connect_once(self, transfer_timeout: Optional[float] = None) -> http.client.HTTPConnection:
         conn = http.client.HTTPConnection(*self._addr, timeout=_CONNECT_TIMEOUT)
@@ -164,9 +239,12 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
         )
         return conn
 
-    def _roundtrip(self, conn, method: str, path: str, payload) -> bytes:
+    def _roundtrip(self, conn, method: str, path: str, payload,
+                   extra_headers: Optional[dict] = None) -> bytes:
         try:
             headers = {"Content-Type": "application/octet-stream"} if payload else {}
+            if extra_headers:
+                headers.update(extra_headers)
             nonce = b""
             if self.auth_key is not None:
                 nonce = os.urandom(16)
@@ -201,7 +279,8 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
         finally:
             conn.close()
 
-    def _call(self, method: str, path: str, payload, op: str) -> bytes:
+    def _call(self, method: str, path: str, payload, op: str,
+              headers: Optional[dict] = None) -> bytes:
         """Dial with the retry budget, then ONE transfer attempt.
 
         Only the dial phase retries: a refused/blackholed host is the
@@ -212,7 +291,8 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
         """
         conn = _retry_connect(self._connect_once, self.master_url, op)
         try:
-            return self._roundtrip(conn, method, path, payload)
+            return self._roundtrip(conn, method, path, payload,
+                                   extra_headers=headers)
         # HTTPException covers a server that closes mid-response (e.g.
         # BadStatusLine/RemoteDisconnected during PS shutdown).
         except (ConnectionError, socket.timeout, TimeoutError, OSError,
@@ -223,20 +303,49 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
                 f"died mid-request): {exc}"
             ) from exc
 
-    def _get(self, path: str, op: str) -> bytes:
-        return self._call("GET", path, None, op)
+    def _get(self, path: str, op: str, headers: Optional[dict] = None) -> bytes:
+        return self._call("GET", path, None, op, headers=headers)
 
     def _post(self, path: str, payload: bytes, op: str) -> bytes:
         return self._call("POST", path, payload, op)
 
     def get_parameters(self):
-        with _ps_span("pull", "http"):
-            return pickle.loads(self._get("/parameters", "get_parameters"))
+        with _ps_span("pull", "http") as sp:
+            headers = None
+            if self.codec == "packed":
+                headers = {"X-Elephas-Codec": "packed"}
+                known = self._pull_cache.known_version()
+                if known is not None:
+                    headers["X-Elephas-Version"] = str(known)
+            body = self._get("/parameters", "get_parameters", headers=headers)
+            # Magic negotiation: a legacy server ignores our codec header
+            # and replies pickle — decode whatever actually came back.
+            if wire.is_packed(body):
+                out = wire.decode(body)
+                if isinstance(out, wire.NotModified):
+                    if sp:
+                        sp.note(codec="packed", payload_bytes=len(body),
+                                cache_hit=True)
+                    return self._pull_cache.resolve(out)
+                self._pull_cache.store(out.version, out.tree)
+                if sp:
+                    sp.note(codec="packed", payload_bytes=len(body))
+                return out.tree
+            if sp:
+                sp.note(codec="pickle", payload_bytes=len(body))
+            return wire.decode_pickle(body)
 
     def update_parameters(self, delta) -> None:
-        with _ps_span("push", "http"):
+        with _ps_span("push", "http") as sp:
             delta = jax.device_get(delta)
-            payload = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)
+            payload, codec = _encode_push(delta, self.codec, self.push_quantize)
+            if isinstance(payload, wire.Frames):
+                # http.client needs one body buffer; the zero-copy chunk
+                # path is the socket transport's.
+                payload = payload.tobytes()
+            if sp:
+                sp.note(codec=codec, payload_bytes=len(payload),
+                        quantize=self.push_quantize)
             self._post("/update", payload, "update_parameters")
 
     def health(self) -> bool:
@@ -259,19 +368,23 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
 
 
 def make_client(
-    mode: str, address: str, auth_key: Optional[bytes] = None
+    mode: str, address: str, auth_key: Optional[bytes] = None,
+    codec: Optional[str] = None, push_quantize: Optional[str] = None,
 ) -> BaseParameterClient:
     """Client for a parameter server reachable at ``address`` ("ip:port").
 
     The cross-host worker path: hosts that did not start the server dial
     the address host 0 broadcast (reference topology — every worker talks
     to the one driver PS, SURVEY.md §3.2). ``auth_key``: the DCN-broadcast
-    HMAC secret for authenticated multi-host wire traffic.
+    HMAC secret for authenticated multi-host wire traffic. ``codec`` /
+    ``push_quantize``: wire codec knobs (see ``HttpClient``).
     """
     if mode == "http":
-        return HttpClient(address, auth_key=auth_key)
+        return HttpClient(address, auth_key=auth_key, codec=codec,
+                          push_quantize=push_quantize)
     if mode == "socket":
-        return SocketClient(address, auth_key=auth_key)
+        return SocketClient(address, auth_key=auth_key, codec=codec,
+                            push_quantize=push_quantize)
     raise ValueError(f"no wire client for parameter_server_mode={mode!r}")
 
 
@@ -279,12 +392,23 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
     """Persistent framed-TCP connection (one per worker thread)."""
 
     def __init__(self, master_url: str, timeout: float = 60.0,
-                 auth_key: Optional[bytes] = None):
+                 auth_key: Optional[bytes] = None,
+                 codec: Optional[str] = None,
+                 push_quantize: Optional[str] = None):
+        """``codec``: 'packed' (default) or 'pickle'. Unlike HTTP there
+        is no transparent downgrade — a legacy socket server closes the
+        connection on the packed frame kinds — so dial old servers with
+        ``codec='pickle'`` (or ``ELEPHAS_PS_CODEC=pickle``).
+        ``push_quantize``: 'bf16'|'f16' lossy delta casting (README
+        caveat); ignored on the pickle codec."""
         host, port = master_url.rsplit(":", 1)
         self.master_url = master_url
         self._addr = (host, int(port))
         self.timeout = timeout
         self.auth_key = auth_key  # HMAC frame secret (utils.sockets)
+        self.codec = _resolve_codec(codec)
+        self.push_quantize = push_quantize
+        self._pull_cache = _PullCache()
         self._sock = None
         self._lock = threading.Lock()  # one in-flight request per connection
 
@@ -345,15 +469,54 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
             pass
 
     def get_parameters(self):
-        with _ps_span("pull", "socket"), self._lock:
-            return self._roundtrip(("g", None), "get_parameters", idempotent=True)
+        with _ps_span("pull", "socket") as sp, self._lock:
+            if self.codec != "packed":
+                tree = self._roundtrip(("g", None), "get_parameters",
+                                       idempotent=True)
+                if sp:
+                    sp.note(codec="pickle")
+                return tree
+            known = self._pull_cache.known_version()
+            reply = self._roundtrip(("G", known), "get_parameters",
+                                    idempotent=True)
+            if not isinstance(reply, (bytes, bytearray, memoryview)):
+                raise RuntimeError(
+                    "parameter server sent a non-packed reply to a packed "
+                    "pull — is it a pre-packed-codec server? dial it with "
+                    "codec='pickle' (or ELEPHAS_PS_CODEC=pickle)"
+                )
+            out = wire.decode(reply)
+            if isinstance(out, wire.NotModified):
+                if sp:
+                    sp.note(codec="packed", payload_bytes=len(reply),
+                            cache_hit=True)
+                return self._pull_cache.resolve(out)
+            self._pull_cache.store(out.version, out.tree)
+            if sp:
+                sp.note(codec="packed", payload_bytes=len(reply))
+            return out.tree
 
     def update_parameters(self, delta) -> None:
-        with _ps_span("push", "socket"):
+        with _ps_span("push", "socket") as sp:
             delta = jax.device_get(delta)
+            frame, codec, nbytes = ("u", delta), "pickle", None
+            if self.codec == "packed":
+                try:
+                    # The Frames go to the socket as scatter-gather
+                    # chunks (no pickle wrapper, no concatenation); the
+                    # server recognizes a raw packed frame as a push by
+                    # its magic. Unpackable structures ride the legacy
+                    # ('u', delta) frame instead.
+                    frames = wire.encode_tree(delta,
+                                              quantize=self.push_quantize)
+                    frame, codec, nbytes = frames, "packed", frames.nbytes
+                except wire.WireFormatError:
+                    pass
             with self._lock:
-                self._roundtrip(("u", delta), "update_parameters",
-                                idempotent=False)
+                self._roundtrip(frame, "update_parameters", idempotent=False)
+            if sp:
+                sp.note(codec=codec, payload_bytes=nbytes,
+                        quantize=self.push_quantize)
 
     def health(self) -> bool:
         """Liveness probe: a barrier *count* on a FRESH connection.

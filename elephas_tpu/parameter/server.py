@@ -9,13 +9,27 @@ All three servers here share one ``ParameterBuffer`` (HBM-resident store +
 lock discipline); the HTTP/socket ones add a wire transport for cross-host
 workers. Flask is replaced by the stdlib ``ThreadingHTTPServer`` — same
 protocol, no dependency.
+
+Wire-transport data path (this PR's throughput rebuild):
+
+- Pulls serve from a **version-gated snapshot cache** (``_SnapshotCache``):
+  the tree is snapshotted under the buffer's read lock, fetched to host and
+  encoded AFTER the lock is released, and the encoded frame is reused for
+  every pull until ``ParameterBuffer.version`` moves — N workers pulling an
+  unchanged model cost ONE serialization, not N (the reference pickled the
+  whole weight list per request, under the handler).
+- Clients that advertise their last-seen version get a 12-byte
+  **not-modified** frame when the buffer hasn't moved — O(header) on the
+  wire instead of O(model).
+- Bodies are **packed-codec** frames (``parameter.wire``) for new peers and
+  pickle for legacy ones, negotiated by magic bytes (HTTP) / explicit frame
+  kinds (socket); pushes accept either codec on one path.
 """
 
 from __future__ import annotations
 
 import hmac
 import os
-import pickle
 import socketserver
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -23,9 +37,63 @@ from typing import Optional
 
 import jax
 
+from elephas_tpu import obs
+from elephas_tpu.parameter import wire
 from elephas_tpu.parameter.base import BaseParameterServer
 from elephas_tpu.parameter.buffer import ParameterBuffer
 from elephas_tpu.utils import sockets as socket_utils
+
+
+def _ps_counters():
+    """(cache_hit, bytes_tx, bytes_rx) server-side data-path counters."""
+    reg = obs.default_registry()
+    return (
+        reg.counter("ps_cache_hit_total",
+                    "pulls answered with a not-modified frame"),
+        reg.counter("ps_bytes_tx", "payload bytes sent by the PS servers"),
+        reg.counter("ps_bytes_rx", "payload bytes received by the PS servers"),
+    )
+
+
+class _SnapshotCache:
+    """Serialize once per ``ParameterBuffer.version``, outside the lock.
+
+    ``frames(codec)`` returns ``(version, payload)`` where ``payload`` is
+    a reusable ``wire.Frames`` (packed) or ``bytes`` (legacy pickle).
+    The snapshot is taken under the buffer's READ lock only
+    (``get_numpy_with_version``); the host fetch and the encode run after
+    release, so writers are never blocked on serialization. A private
+    lock single-flights the encode — concurrent pulls at the same
+    version wait for one encoding instead of each doing their own.
+
+    Staleness safety: the buffer reads its version BEFORE the snapshot,
+    so a racing hogwild apply can only make the cached content NEWER
+    than its key — the next pull re-encodes (version mismatch) rather
+    than ever serving a stale not-modified (see
+    ``ParameterBuffer.get_with_version``).
+    """
+
+    def __init__(self, buffer: ParameterBuffer):
+        self._buffer = buffer
+        self._encode_lock = threading.Lock()
+        self._entries: dict = {}  # codec -> (version, frames|bytes)
+
+    def frames(self, codec: str):
+        entry = self._entries.get(codec)
+        if entry is not None and entry[0] == self._buffer.version:
+            return entry
+        with self._encode_lock:
+            entry = self._entries.get(codec)
+            if entry is not None and entry[0] == self._buffer.version:
+                return entry
+            version, snap = self._buffer.get_numpy_with_version()
+            if codec == "packed":
+                payload = wire.encode_tree(snap, version=version)
+            else:
+                payload = wire.encode_pickle(snap)
+            entry = (version, payload)
+            self._entries[codec] = entry
+            return entry
 
 
 def _default_bind_host() -> str:
@@ -138,6 +206,8 @@ class HttpServer(BaseParameterServer):
         barriers = self.barriers
         auth_key = self.auth_key
         replay_guard = self.replay_guard
+        cache = self._cache = _SnapshotCache(buffer)
+        cache_hits, bytes_tx, bytes_rx = _ps_counters()
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # silence per-request stderr spam
@@ -169,22 +239,34 @@ class HttpServer(BaseParameterServer):
                 self.send_error(403, "authentication failed")
                 return False
 
-            def _reply(self, body: bytes, content_type: Optional[str] = None) -> None:
+            def _reply(self, body, content_type: Optional[str] = None,
+                       version: Optional[int] = None) -> None:
+                # body: bytes OR wire.Frames — frames are written chunk
+                # by chunk (no header+payload concatenation).
+                chunks = body.chunks if isinstance(body, socket_utils.RawPayload) \
+                    else [body]
+                nbytes = body.nbytes if isinstance(body, socket_utils.RawPayload) \
+                    else len(body)
                 self.send_response(200)
                 if content_type:
                     self.send_header("Content-Type", content_type)
+                if version is not None:
+                    self.send_header("X-Elephas-Version", str(version))
                 if auth_key is not None:
                     # Bound to the request nonce: stale responses can't
-                    # be replayed into a different exchange.
+                    # be replayed into a different exchange. Incremental
+                    # MAC over the chunks — no full-body copy.
                     self.send_header(
                         "X-Elephas-Auth",
-                        socket_utils.frame_mac(
-                            auth_key, getattr(self, "_req_nonce", b"") + body
+                        socket_utils.chunks_mac(
+                            auth_key,
+                            [getattr(self, "_req_nonce", b""), *chunks],
                         ).hex(),
                     )
-                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Length", str(nbytes))
                 self.end_headers()
-                self.wfile.write(body)
+                for chunk in chunks:
+                    self.wfile.write(chunk)
 
             def do_GET(self):  # noqa: N802
                 path = self.path.rstrip("/")
@@ -194,12 +276,23 @@ class HttpServer(BaseParameterServer):
                 if not self._authed():
                     return
                 if path == "/parameters":
-                    self._reply(
-                        pickle.dumps(
-                            buffer.get_numpy(), protocol=pickle.HIGHEST_PROTOCOL
-                        ),
-                        content_type="application/octet-stream",
-                    )
+                    # Codec negotiation: packed-aware clients say so; the
+                    # default stays pickle for legacy peers. The encoded
+                    # snapshot comes from the version-gated cache — the
+                    # buffer lock is never held across serialization.
+                    codec = "packed" if self.headers.get(
+                        "X-Elephas-Codec") == "packed" else "pickle"
+                    known = self.headers.get("X-Elephas-Version")
+                    version, payload = cache.frames(codec)
+                    if codec == "packed" and known is not None \
+                            and known == str(version):
+                        payload = wire.encode_not_modified(version)
+                        cache_hits.inc()
+                    bytes_tx.inc(payload.nbytes if isinstance(
+                        payload, socket_utils.RawPayload) else len(payload))
+                    self._reply(payload,
+                                content_type="application/octet-stream",
+                                version=version)
                 elif path.startswith("/barrier/"):
                     self._reply(str(barriers.count(path[len("/barrier/"):])).encode())
                 else:
@@ -212,7 +305,12 @@ class HttpServer(BaseParameterServer):
                 if not self._authed(body):
                     return
                 if path == "/update":
-                    buffer.apply_delta(pickle.loads(body))
+                    # _authed() ran on the raw body FIRST — neither codec
+                    # sees unauthenticated bytes when a key is set. The
+                    # body self-describes (packed magic vs pickle), so
+                    # one endpoint serves both codecs' pushes.
+                    bytes_rx.inc(len(body))
+                    buffer.apply_delta(wire.decode_payload(body))
                     self._reply(b"")
                 elif path.startswith("/barrier/"):
                     self._reply(str(barriers.arrive(path[len("/barrier/"):])).encode())
@@ -252,24 +350,48 @@ class _SocketHandler(socketserver.BaseRequestHandler):
         barriers = self.server.barriers  # type: ignore[attr-defined]
         key = self.server.auth_key  # type: ignore[attr-defined]
         guard = self.server.replay_guard  # type: ignore[attr-defined]
+        cache = self.server.cache  # type: ignore[attr-defined]
+        cache_hits, bytes_tx, bytes_rx = _ps_counters()
         try:
             while True:
                 # With auth_key set, receive() verifies the frame's HMAC
-                # and replay-freshness BEFORE unpickling; a bad tag or a
-                # replayed nonce raises ConnectionError and the
-                # connection closes without touching the buffer. Replies
-                # are MAC-bound to the request's nonce (advisor r4) so a
-                # captured response can't be replayed into a later
-                # exchange — the client verifies with the nonce it sent.
-                (kind, payload), req_nonce = socket_utils.receive(
+                # and replay-freshness BEFORE any payload decode (pickle
+                # OR packed); a bad tag or a replayed nonce raises
+                # ConnectionError and the connection closes without
+                # touching the buffer. Replies are MAC-bound to the
+                # request's nonce (advisor r4) so a captured response
+                # can't be replayed into a later exchange — the client
+                # verifies with the nonce it sent.
+                obj, req_nonce = socket_utils.receive(
                     self.request, key=key, replay_guard=guard, return_nonce=True
                 )
 
                 def reply(obj):
+                    if isinstance(obj, socket_utils.RawPayload):
+                        bytes_tx.inc(obj.nbytes)
                     socket_utils.send(self.request, obj, key=key, bind=req_nonce)
 
-                if kind == "g":
-                    reply(buffer.get_numpy())
+                # A raw (non-pickled) payload is a packed-codec PUSH:
+                # the frame body IS the delta, sent without a pickle
+                # wrapper so the server decodes it zero-copy.
+                if isinstance(obj, (bytes, bytearray, memoryview)):
+                    mv = memoryview(obj)
+                    bytes_rx.inc(mv.nbytes)
+                    buffer.apply_delta(wire.decode_payload(mv))
+                    reply(b"ok")
+                    continue
+
+                kind, payload = obj
+                if kind == "g":  # legacy pull → cached pickle snapshot
+                    _, snap = cache.frames("pickle")
+                    reply(socket_utils.RawPayload([snap]))
+                elif kind == "G":  # packed pull, payload = last-seen version
+                    version, frames = cache.frames("packed")
+                    if payload is not None and payload == version:
+                        cache_hits.inc()
+                        reply(wire.encode_not_modified(version))
+                    else:
+                        reply(frames)
                 elif kind == "u":
                     buffer.apply_delta(payload)
                     reply(b"ok")
@@ -319,6 +441,7 @@ class SocketServer(BaseParameterServer):
     def start(self) -> None:
         self._server = _ThreadingTCPServer((self.host, self.port), _SocketHandler)
         self._server.buffer = self.buffer  # type: ignore[attr-defined]
+        self._server.cache = _SnapshotCache(self.buffer)  # type: ignore[attr-defined]
         self._server.barriers = self.barriers  # type: ignore[attr-defined]
         self._server.auth_key = self.auth_key  # type: ignore[attr-defined]
         self._server.replay_guard = self.replay_guard  # type: ignore[attr-defined]

@@ -1,0 +1,360 @@
+"""Packed zero-copy wire codec for parameter-server payloads.
+
+The reference ships every ``GET /parameters`` / ``POST /update`` as a
+pickled weight list (SURVEY.md §2.1) and our port kept that cost: a
+pull re-pickled the whole nested numpy tree per request (one full copy
+serverside), and a push unpickled into fresh allocations. This module
+replaces pickle on the PS hot path with a *packed* frame:
+
+    [magic "EPK1"][u32 header_len][header JSON][pad][payload region]
+
+- The header is small JSON metadata: a structure *skeleton* (dict keys /
+  list arity, with leaves as indices), and per-leaf ``(dtype, shape,
+  offset, nbytes, qdtype, scale)`` rows pointing into ONE contiguous
+  payload region.
+- **Encode is zero-copy**: each contiguous leaf is emitted as a
+  ``memoryview`` of its own buffer (``Frames.chunks``) — the socket
+  layer writes the chunks out without ever concatenating
+  header+MAC+payload into a throwaway ``bytes``.
+- **Decode is zero-copy**: leaves come back as ``np.frombuffer`` views
+  into the received frame, so a 46 MB pull costs zero deserialization
+  copies (the views are read-only; ``jax.device_put`` copies them onto
+  the chip as it would any host array).
+- Optional **delta quantization** (``quantize='bf16'|'f16'``) halves
+  push bytes: float leaves are cast per-leaf (f16 with a per-leaf scale
+  so large deltas don't overflow the ±65504 range; bf16 keeps f32's
+  exponent so scale stays 1). Decode restores the original dtype.
+  Quantization is lossy — see README's convergence caveat; the
+  unquantized path is bit-exact.
+- **Magic-byte negotiation**: frames are self-describing. ``is_packed``
+  sniffs the 4-byte magic, so every receive path accepts packed AND
+  legacy pickle bytes (pickle protocol ≥2 starts with ``b"\\x80"``,
+  which can never collide with the ASCII magics) — legacy pickle peers
+  keep working against the new servers.
+- A **not-modified** frame (magic ``EPNM`` + u64 version, 12 bytes)
+  answers a pull whose client already holds the current
+  ``ParameterBuffer.version`` — O(header) on the wire instead of
+  O(model).
+
+This module is also the ONLY sanctioned home of ``pickle`` in
+``elephas_tpu/parameter/`` (``encode_pickle``/``decode_pickle``);
+``scripts/lint_blocking.py`` rejects direct pickle calls elsewhere in
+the package so the hot path can't silently regress.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elephas_tpu.utils.sockets import MAGIC_NOTMOD, MAGIC_TREE, RawPayload
+
+__all__ = [
+    "DecodedTree",
+    "Frames",
+    "NotModified",
+    "WireFormatError",
+    "decode",
+    "decode_payload",
+    "decode_pickle",
+    "encode_not_modified",
+    "encode_pickle",
+    "encode_tree",
+    "is_packed",
+]
+
+_HLEN = struct.Struct("!I")
+_U64 = struct.Struct("!Q")
+_ALIGN = 64  # leaf offsets are 64B-aligned so frombuffer views vectorize
+_PREFIX = len(MAGIC_TREE) + _HLEN.size
+
+# f16 quantization headroom: per-leaf scale maps max|x| to this, safely
+# inside float16's ±65504 so the cast never overflows to inf.
+_F16_HEADROOM = 6.0e4
+
+
+class WireFormatError(ValueError):
+    """Malformed, truncated, or structurally unsupported wire frame."""
+
+
+class Frames(RawPayload):
+    """An encoded frame as scatter-gather chunks (no concatenation).
+
+    ``chunks`` is a list of buffer-protocol objects (the header bytes,
+    per-leaf alignment pads, and zero-copy leaf memoryviews);
+    ``nbytes`` is their total. The socket layer sends chunks directly
+    (``utils.sockets.send``), the HTTP server writes them sequentially
+    after Content-Length; ``tobytes()`` is for callers that need one
+    buffer (HTTP client request bodies, tests).
+    """
+
+    __slots__ = ()
+
+    def tobytes(self) -> bytes:
+        return b"".join(bytes(c) for c in self.chunks)
+
+
+class NotModified:
+    """Decoded ``EPNM`` frame: the server's tree is unchanged at ``version``."""
+
+    __slots__ = ("version",)
+
+    def __init__(self, version: int):
+        self.version = version
+
+    def __repr__(self):
+        return f"NotModified(version={self.version})"
+
+
+class DecodedTree:
+    """Decoded ``EPK1`` frame: ``tree`` (zero-copy leaves) + ``version``."""
+
+    __slots__ = ("tree", "version")
+
+    def __init__(self, tree, version: Optional[int]):
+        self.tree = tree
+        self.version = version
+
+
+def is_packed(buf) -> bool:
+    """True iff ``buf`` starts with a packed-codec magic."""
+    head = bytes(memoryview(buf)[:4])
+    return head == MAGIC_TREE or head == MAGIC_NOTMOD
+
+
+# -- structure skeleton -------------------------------------------------------
+#
+# The skeleton mirrors the pytree's container structure in JSON with
+# leaves replaced by payload indices:  ["d", [[key, sub], ...]] for
+# dicts, ["l"/"t", [sub, ...]] for lists/tuples, ["z"] for None, and
+# ["f", i] for leaf i. Unlike path lists it round-trips EMPTY subtrees
+# (``{"a": {}}``) exactly. Containers outside dict/list/tuple (custom
+# pytree nodes) raise WireFormatError — callers fall back to pickle.
+
+
+def _build_skeleton(obj, leaves: List[Any]):
+    if obj is None:
+        return ["z"]
+    if isinstance(obj, dict):
+        items = []
+        for key, val in obj.items():
+            if not isinstance(key, (str, int, float, bool)):
+                raise WireFormatError(
+                    f"packed codec needs JSON-able dict keys, got {type(key)}"
+                )
+            items.append([key, _build_skeleton(val, leaves)])
+        return ["d", items]
+    if isinstance(obj, (list, tuple)):
+        tag = "l" if isinstance(obj, list) else "t"
+        return [tag, [_build_skeleton(v, leaves) for v in obj]]
+    idx = len(leaves)
+    leaves.append(obj)
+    return ["f", idx]
+
+
+def _restore_skeleton(skel, leaves: List[Any]):
+    tag = skel[0]
+    if tag == "z":
+        return None
+    if tag == "f":
+        return leaves[skel[1]]
+    if tag == "d":
+        return {key: _restore_skeleton(sub, leaves) for key, sub in skel[1]}
+    if tag == "l":
+        return [_restore_skeleton(sub, leaves) for sub in skel[1]]
+    if tag == "t":
+        return tuple(_restore_skeleton(sub, leaves) for sub in skel[1])
+    raise WireFormatError(f"unknown skeleton tag {tag!r}")
+
+
+# -- dtypes -------------------------------------------------------------------
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """dtype by name, reaching into ml_dtypes for bf16 & friends."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError):
+        raise WireFormatError(f"unknown wire dtype {name!r}") from None
+
+
+def _quantize_leaf(arr: np.ndarray, quantize: str) -> Tuple[np.ndarray, str, float]:
+    """(quantized array, qdtype name, scale). Raises on unknown mode."""
+    if quantize == "bf16":
+        import ml_dtypes
+
+        return arr.astype(ml_dtypes.bfloat16), "bfloat16", 1.0
+    if quantize == "f16":
+        amax = float(np.max(np.abs(arr))) if arr.size else 0.0
+        if not np.isfinite(amax) or amax == 0.0:
+            scale = 1.0
+        else:
+            scale = amax / _F16_HEADROOM
+        return (arr / scale).astype(np.float16), "float16", scale
+    raise WireFormatError(f"quantize must be 'bf16'|'f16', got {quantize!r}")
+
+
+def _leaf_chunk(arr: np.ndarray):
+    """A zero-copy byte view of a contiguous array (copy only if the
+    buffer protocol refuses the dtype — e.g. some extension dtypes)."""
+    try:
+        return memoryview(arr).cast("B")
+    except (TypeError, ValueError, BufferError):
+        return arr.tobytes()
+
+
+# -- encode -------------------------------------------------------------------
+
+
+def encode_tree(tree, version: Optional[int] = None,
+                quantize: Optional[str] = None) -> Frames:
+    """Encode a pytree of arrays/scalars into a packed frame.
+
+    Raises ``WireFormatError`` for structures the skeleton can't carry
+    (non-JSON dict keys, custom container nodes) — callers fall back to
+    ``encode_pickle``.
+    """
+    leaves: List[Any] = []
+    skeleton = _build_skeleton(tree, leaves)
+
+    rows = []          # (dtype, shape, offset, nbytes, qdtype, scale)
+    payload_chunks = []  # alternating pads + leaf views
+    offset = 0
+    for leaf in leaves:
+        arr = np.ascontiguousarray(leaf)
+        if arr.dtype == object:
+            raise WireFormatError("object-dtype leaf has no wire layout")
+        qdtype, scale = None, None
+        if quantize is not None and arr.dtype.kind == "f" \
+                and arr.dtype.itemsize > 2:
+            arr, qdtype, scale = _quantize_leaf(arr, quantize)
+        pad = (-offset) % _ALIGN
+        if pad:
+            payload_chunks.append(b"\x00" * pad)
+            offset += pad
+        rows.append([np.asarray(leaf).dtype.name, list(np.shape(leaf)),
+                     offset, arr.nbytes, qdtype, scale])
+        payload_chunks.append(_leaf_chunk(arr))
+        offset += arr.nbytes
+
+    header = json.dumps(
+        {"v": 1, "ver": version, "skel": skeleton, "leaves": rows},
+        separators=(",", ":"),
+    ).encode()
+    # Pad the header with spaces (JSON-transparent) so the payload
+    # region starts 64B-aligned relative to the frame start.
+    header += b" " * ((-(_PREFIX + len(header))) % _ALIGN)
+    head = MAGIC_TREE + _HLEN.pack(len(header)) + header
+    return Frames([head, *payload_chunks])
+
+
+def encode_not_modified(version: int) -> Frames:
+    """The 12-byte "your snapshot is current" reply frame."""
+    return Frames([MAGIC_NOTMOD + _U64.pack(int(version))])
+
+
+def encode_pickle(obj) -> bytes:
+    """Legacy pickle codec — the package's ONLY sanctioned pickle.dumps."""
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode_pickle(buf):
+    """Legacy pickle codec — the package's ONLY sanctioned pickle.loads.
+
+    Callers MUST have authenticated ``buf`` first when a wire auth key
+    is configured (``utils.sockets`` verifies HMAC before any payload
+    reaches this)."""
+    return pickle.loads(bytes(buf) if isinstance(buf, memoryview) else buf)
+
+
+# -- decode -------------------------------------------------------------------
+
+
+def decode(buf, expect_treedef=None):
+    """Decode one packed frame → ``DecodedTree`` | ``NotModified``.
+
+    Leaves are read-only ``np.frombuffer`` views into ``buf`` (keep it
+    alive as long as the tree). ``expect_treedef`` (a
+    ``jax.tree_util`` treedef) turns a structure mismatch into a
+    ``WireFormatError`` instead of a downstream apply error.
+    """
+    mv = memoryview(buf)
+    if mv.ndim != 1 or mv.format != "B":
+        mv = mv.cast("B")
+    head = bytes(mv[:4])
+    if head == MAGIC_NOTMOD:
+        if len(mv) < 4 + _U64.size:
+            raise WireFormatError("truncated not-modified frame")
+        return NotModified(_U64.unpack_from(mv, 4)[0])
+    if head != MAGIC_TREE:
+        raise WireFormatError(
+            f"not a packed frame (magic {head!r}; legacy pickle bodies "
+            "go through decode_payload/decode_pickle)"
+        )
+    if len(mv) < _PREFIX:
+        raise WireFormatError("truncated packed frame header")
+    (hlen,) = _HLEN.unpack_from(mv, 4)
+    if _PREFIX + hlen > len(mv):
+        raise WireFormatError("packed frame shorter than its header length")
+    try:
+        header = json.loads(bytes(mv[_PREFIX:_PREFIX + hlen]))
+    except ValueError as exc:
+        raise WireFormatError(f"corrupt packed frame header: {exc}") from exc
+    if header.get("v") != 1:
+        raise WireFormatError(f"unsupported packed frame version {header.get('v')!r}")
+
+    payload = mv[_PREFIX + hlen:]
+    leaves = []
+    for dtype_name, shape, offset, nbytes, qdtype, scale in header["leaves"]:
+        if offset + nbytes > len(payload):
+            raise WireFormatError(
+                f"leaf at offset {offset} (+{nbytes}B) overruns the "
+                f"{len(payload)}B payload region (truncated frame?)"
+            )
+        wire_dtype = _np_dtype(qdtype or dtype_name)
+        arr = np.frombuffer(payload, dtype=wire_dtype,
+                            count=nbytes // wire_dtype.itemsize,
+                            offset=offset).reshape(shape)
+        if qdtype is not None:
+            out_dtype = _np_dtype(dtype_name)
+            arr = arr.astype(out_dtype)
+            if scale != 1.0:
+                arr = arr * out_dtype.type(scale)
+        leaves.append(arr)
+    tree = _restore_skeleton(header["skel"], leaves)
+    if expect_treedef is not None:
+        import jax
+
+        got = jax.tree_util.tree_structure(tree)
+        if got != expect_treedef:
+            raise WireFormatError(
+                f"packed frame treedef mismatch: got {got}, expected "
+                f"{expect_treedef}"
+            )
+    return DecodedTree(tree, header.get("ver"))
+
+
+def decode_payload(buf, expect_treedef=None):
+    """Decode a request/response body of EITHER codec into a tree.
+
+    Magic-byte negotiation: packed frames are self-describing, anything
+    else is legacy pickle — so one receive path serves new packed peers
+    and old pickle peers alike. A ``NotModified`` frame is invalid here
+    (it only answers version-gated pulls).
+    """
+    if is_packed(buf):
+        out = decode(buf, expect_treedef=expect_treedef)
+        if isinstance(out, NotModified):
+            raise WireFormatError("not-modified frame where a tree was expected")
+        return out.tree
+    return decode_pickle(buf)

@@ -8,7 +8,10 @@ length prefix over raw TCP and discovers the driver endpoint via
 Here the same framing carries *control-plane* traffic only (async-mode
 deltas between hosts, trial dispatch). Tensor data between chips rides ICI
 via XLA collectives (SURVEY.md §2.3) and never touches these sockets on
-the single-host path. Frames are ``!Q``-length-prefixed pickles; because
+the single-host path. Frames are ``!Q``-length-prefixed pickles — or,
+for the parameter-server hot path, pre-encoded packed-codec payloads
+(``RawPayload``) recognized by magic bytes and sent/received without a
+pickle round-trip or a full-frame copy. Because
 ``pickle.loads`` on attacker bytes is code execution, frames can carry an
 HMAC-SHA256 tag (``key=``): the receiver verifies the tag BEFORE
 unpickling and treats a mismatch as a connection error. Multi-host runs
@@ -34,10 +37,61 @@ _NONCE_LEN = 16
 _TS = struct.Struct("!d")
 _AUTH_HDR_LEN = _NONCE_LEN + _TS.size
 
+# Packed-codec frame magics (defined HERE, not in parameter/wire.py,
+# because wire.py imports this module — parameter/__init__ pulls in
+# server/client which pull in sockets). Pickle protocol ≥2 bodies start
+# with b"\x80", so sniffing these ASCII magics can never misclassify a
+# legacy pickle peer's frame.
+MAGIC_TREE = b"EPK1"  # packed tensor tree (parameter.wire.encode_tree)
+MAGIC_NOTMOD = b"EPNM"  # tiny "not modified since version" reply
+_PACKED_MAGICS = (MAGIC_TREE, MAGIC_NOTMOD)
+
+_SEND_CHUNK = 1 << 20  # slice large buffers so no send stages a huge copy
+
+
+class RawPayload:
+    """A pre-encoded wire payload as scatter-gather ``chunks``.
+
+    ``send`` ships a ``RawPayload`` WITHOUT pickling and WITHOUT
+    concatenating header+MAC+payload into one throwaway ``bytes`` — the
+    MAC is computed incrementally over the chunks and each chunk goes to
+    the socket as a ``memoryview`` slice. ``receive`` hands packed
+    payloads (recognized by magic) back as raw bytes for the caller's
+    codec; anything else is treated as legacy pickle.
+    """
+
+    __slots__ = ("chunks", "nbytes")
+
+    def __init__(self, chunks):
+        self.chunks = [
+            c if isinstance(c, memoryview) else memoryview(c) for c in chunks
+        ]
+        self.nbytes = sum(c.nbytes for c in self.chunks)
+
 
 def frame_mac(key: bytes, payload: bytes) -> bytes:
     """HMAC-SHA256 tag for one wire payload."""
     return hmac.new(key, payload, hashlib.sha256).digest()
+
+
+def chunks_mac(key: bytes, parts) -> bytes:
+    """HMAC-SHA256 over a sequence of buffers without concatenating."""
+    mac = hmac.new(key, digestmod=hashlib.sha256)
+    for part in parts:
+        mac.update(part)
+    return mac.digest()
+
+
+def _sendall_chunks(sock: socket.socket, chunks) -> None:
+    """Write each chunk, slicing big ones so no full-frame copy is staged."""
+    for chunk in chunks:
+        view = chunk if isinstance(chunk, memoryview) else memoryview(chunk)
+        if view.nbytes <= _SEND_CHUNK:
+            sock.sendall(view)
+            continue
+        view = view.cast("B")
+        for off in range(0, view.nbytes, _SEND_CHUNK):
+            sock.sendall(view[off:off + _SEND_CHUNK])
 
 
 class ReplayGuard:
@@ -131,28 +185,50 @@ def send(
     servers bind replies to the REQUEST's nonce so a captured response
     can't be replayed into a later exchange (the receiver must pass the
     same ``bind``). Returns this frame's nonce (b"" when keyless) so
-    callers can bind the reply they are about to read."""
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    callers can bind the reply they are about to read.
+
+    A ``RawPayload`` (packed-codec frames) is sent as-is: its chunks go
+    out as memoryview slices after the small length/MAC/nonce prefix —
+    the payload is never copied into a contiguous frame, and the MAC is
+    computed incrementally over the same chunks."""
+    if isinstance(obj, RawPayload):
+        chunks, payload_len = obj.chunks, obj.nbytes
+    else:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        chunks, payload_len = [payload], len(payload)
     if key is not None:
         nonce = os.urandom(_NONCE_LEN)
-        body = nonce + _TS.pack(time.time()) + payload
-        sock.sendall(
-            _LEN.pack(len(body) + _MAC_LEN) + frame_mac(key, bind + body) + body
-        )
+        auth_hdr = nonce + _TS.pack(time.time())
+        mac = chunks_mac(key, [bind, auth_hdr, *chunks])
+        prefix = _LEN.pack(payload_len + _AUTH_HDR_LEN + _MAC_LEN) + mac + auth_hdr
+        _sendall_chunks(sock, [prefix, *chunks])
         return nonce
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    _sendall_chunks(sock, [_LEN.pack(payload_len), *chunks])
     return b""
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> memoryview:
+    """Read exactly ``n`` bytes into ONE preallocated buffer (recv_into,
+    no chunk-list join copy) and return a read-write view of it."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    pos = 0
+    while pos < n:
+        got = sock.recv_into(view[pos:], min(n - pos, 1 << 20))
+        if not got:
             raise ConnectionError("socket closed mid-frame")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        pos += got
+    return view
+
+
+def _loads_or_raw(payload: memoryview):
+    """Magic-byte negotiation: packed-codec payloads come back RAW
+    (bytes-like, for ``parameter.wire.decode``); anything else is a
+    legacy pickle frame and is unpickled here. Only called AFTER the
+    HMAC check when a key is configured."""
+    if bytes(payload[:4]) in _PACKED_MAGICS:
+        return payload
+    return pickle.loads(payload)
 
 
 def receive(
@@ -164,27 +240,33 @@ def receive(
 ):
     """Receive one length-prefixed pickled object (inverse of ``send``).
 
-    With ``key``, the frame's HMAC tag is verified BEFORE unpickling —
-    unauthenticated or tampered bytes never reach ``pickle.loads``.
+    With ``key``, the frame's HMAC tag is verified BEFORE any payload
+    decode — unauthenticated or tampered bytes never reach
+    ``pickle.loads`` (and never reach the packed codec either; the
+    magic sniff below happens strictly after the MAC check).
     ``replay_guard`` (servers) additionally rejects duplicate/stale
     nonces under the MAC. ``bind`` must match the sender's (clients pass
     their request nonce when reading the reply). ``return_nonce=True``
-    returns ``(obj, nonce)`` so servers can bind their reply."""
+    returns ``(obj, nonce)`` so servers can bind their reply.
+
+    Packed-codec payloads (``MAGIC_TREE``/``MAGIC_NOTMOD``) are returned
+    as raw bytes-like views for ``parameter.wire`` to decode zero-copy;
+    everything else unpickles as before."""
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     data = _recv_exact(sock, length)
     if key is not None:
         if length < _MAC_LEN + _AUTH_HDR_LEN:
             raise ConnectionError("authenticated frame shorter than its header")
         tag, body = data[:_MAC_LEN], data[_MAC_LEN:]
-        if not hmac.compare_digest(tag, frame_mac(key, bind + body)):
+        if not hmac.compare_digest(tag, chunks_mac(key, [bind, body])):
             raise ConnectionError(
                 "wire-frame authentication failed (bad or missing HMAC)"
             )
-        nonce = body[:_NONCE_LEN]
+        nonce = bytes(body[:_NONCE_LEN])
         (ts,) = _TS.unpack(body[_NONCE_LEN:_AUTH_HDR_LEN])
         if replay_guard is not None:
             replay_guard.check(nonce, ts)
-        obj = pickle.loads(body[_AUTH_HDR_LEN:])
+        obj = _loads_or_raw(body[_AUTH_HDR_LEN:])
         return (obj, nonce) if return_nonce else obj
-    obj = pickle.loads(data)
+    obj = _loads_or_raw(data)
     return (obj, b"") if return_nonce else obj

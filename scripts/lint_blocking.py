@@ -32,6 +32,17 @@ device (caller-supplied python ints, numpy buffers already fetched
 through ``host_sync``), or host-only timing genuinely outside the
 scheduled path. The pragma keeps every exemption greppable.
 
+A third rule guards the PARAMETER-SERVER WIRE PATH: the packed codec
+(``elephas_tpu/parameter/wire.py``) replaced per-request pickling on
+the PS hot path, and ``wire.encode_pickle``/``wire.decode_pickle`` are
+the only sanctioned legacy-interop entry points. A direct
+``pickle.dumps(...)`` / ``pickle.loads(...)`` (or ``dump``/``load``)
+anywhere else in ``elephas_tpu/parameter/`` silently reintroduces the
+full-copy serialization the codec exists to remove — and worse, a
+``loads`` added before the HMAC check would reopen the
+verify-before-decode hole. Flagged outside ``wire.py``; the escape
+pragma is ``# pickle-ok``.
+
 Wired into tier-1 via ``tests/test_lint_blocking.py``; also runnable
 standalone: ``python scripts/lint_blocking.py`` (exit 1 on violations).
 """
@@ -45,8 +56,11 @@ from typing import List, NamedTuple
 
 PRAGMA = "host-ok"
 SANCTIONED = "host_sync.py"
+PICKLE_PRAGMA = "pickle-ok"
+PICKLE_SANCTIONED = "wire.py"
 _NUMPY_NAMES = ("np", "numpy")
 _CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
+_PICKLE_ATTRS = ("dumps", "loads", "dump", "load")
 
 
 class Violation(NamedTuple):
@@ -56,6 +70,14 @@ class Violation(NamedTuple):
     line: str
 
     def __str__(self):
+        if self.call.startswith("pickle."):
+            return (
+                f"{self.path}:{self.lineno}: direct `{self.call}` outside "
+                f"wire.py reintroduces per-request pickling on the PS hot "
+                f"path (route through wire.encode_pickle/decode_pickle; "
+                f"`# {PICKLE_PRAGMA}` only for data that never crosses the "
+                f"wire)\n    {self.line.strip()}"
+            )
         if self.call.startswith("time."):
             return (
                 f"{self.path}:{self.lineno}: raw clock call `{self.call}` "
@@ -116,12 +138,62 @@ def lint_package(root: Path) -> List[Violation]:
     return out
 
 
+def _pickle_call_name(node: ast.Call) -> str | None:
+    """``pickle.dumps``-style attribute calls; bare ``loads(...)`` from a
+    ``from pickle import loads`` is caught too (module-qualified name is
+    synthesized so the message stays uniform)."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _PICKLE_ATTRS \
+            and isinstance(fn.value, ast.Name) \
+            and fn.value.id in ("pickle", "cPickle"):
+        return f"pickle.{fn.attr}"
+    return None
+
+
+def lint_pickle_file(path: Path) -> List[Violation]:
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    imported = set()  # names bound by `from pickle import dumps as d`
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "pickle":
+            for alias in node.names:
+                if alias.name in _PICKLE_ATTRS:
+                    imported.add(alias.asname or alias.name)
+        if not isinstance(node, ast.Call):
+            continue
+        name = _pickle_call_name(node)
+        if name is None and isinstance(node.func, ast.Name) \
+                and node.func.id in imported:
+            name = f"pickle.{node.func.id}"
+        if name is None:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if PICKLE_PRAGMA in line:
+            continue
+        out.append(Violation(str(path), node.lineno, name, line))
+    return out
+
+
+def lint_pickle_package(root: Path) -> List[Violation]:
+    """Lint every module in the parameter package except the sanctioned
+    codec home itself."""
+    out = []
+    for path in sorted(root.glob("*.py")):
+        if path.name == PICKLE_SANCTIONED:
+            continue
+        out.extend(lint_pickle_file(path))
+    return out
+
+
 def main(argv: List[str] | None = None) -> List[Violation]:
     args = list(sys.argv[1:] if argv is None else argv)
-    root = Path(args[0]) if args else (
-        Path(__file__).resolve().parent.parent / "elephas_tpu" / "serving"
-    )
+    pkg_root = Path(__file__).resolve().parent.parent / "elephas_tpu"
+    root = Path(args[0]) if args else (pkg_root / "serving")
     violations = lint_package(root)
+    if not args:
+        violations.extend(lint_pickle_package(pkg_root / "parameter"))
     for v in violations:
         print(v)
     if not violations:

@@ -1,0 +1,271 @@
+"""Parameter-server data-path bench: packed wire codec vs legacy pickle,
+version-gated snapshot cache, and pipelined vs serial worker comms.
+
+Emits one JSON object per measurement so the numbers land as a committed
+artifact (``--out BENCH_PS.json``):
+
+- ``{"mode": "codec", "codec": "packed" | "pickle", "op": ...}`` —
+  serialize/deserialize throughput (MB/s) of a ResNet-18-sized float32
+  tree (~11.7M params / ~46.8 MB). ``op`` is ``encode`` (server-side
+  pull serialize / client-side push serialize; packed counts its
+  scatter-gather chunk assembly, the form the socket layer actually
+  sends) or ``decode`` (packed returns ``np.frombuffer`` views — the
+  zero-copy claim is THIS row). ``quantize`` rows show the bf16/f16
+  push-bytes halving.
+- ``{"mode": "cache"}`` — wire bytes of a cache MISS (full packed
+  frame, O(model)) vs a cache HIT (12-byte not-modified frame,
+  O(header)), plus the measured hit/miss reply latency against a live
+  ``HttpServer``.
+- ``{"mode": "transport", ...}`` — live end-to-end pull+push round
+  trips/sec over HTTP loopback, packed vs pickle arm.
+- ``{"mode": "pipeline", "pipelined": bool}`` — per-unit wall time of a
+  simulated worker loop (pull → train → push, train simulated as a
+  fixed sleep) against a live server: the serial arm pays
+  train+wire per unit, the pipelined arm overlaps them via
+  ``_CommsPipeline`` prefetch + fire-and-forget push.
+
+Importable (and runnable with tiny defaults) without a TPU — wire+codec
+paths are pure numpy/sockets; real numbers come from the dev host.
+
+Usage: python scripts/ps_bench.py [--reps 5] [--units 30]
+       [--train-ms 25] [--small] [--out BENCH_PS.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def resnet18_tree(small: bool = False) -> dict:
+    """A ResNet-18-shaped float32 parameter tree (~11.7M params).
+
+    Shapes follow the torchvision layout (conv1 7x7x3x64, four stages of
+    two basic blocks, fc 512x1000); exact micro-architecture doesn't
+    matter — the bench needs the leaf-count/size DISTRIBUTION (many
+    medium conv kernels + one big fc) more than the wiring.
+    """
+    if small:  # tier-1 smoke: same structure, 1/8 channel widths
+        widths, fc_in = [8, 16, 32, 64], 64
+    else:
+        widths, fc_in = [64, 128, 256, 512], 512
+    rng = np.random.default_rng(0)
+
+    def conv(cin, cout, k=3):
+        return rng.standard_normal((k, k, cin, cout)).astype(np.float32)
+
+    tree = {"conv1": {"kernel": conv(3, widths[0], 7)},
+            "bn1": {"scale": np.ones(widths[0], np.float32),
+                    "bias": np.zeros(widths[0], np.float32)}}
+    cin = widths[0]
+    for stage, cout in enumerate(widths):
+        for block in range(2):
+            name = f"layer{stage + 1}_block{block}"
+            tree[name] = {
+                "conv1": {"kernel": conv(cin, cout)},
+                "bn1": {"scale": np.ones(cout, np.float32),
+                        "bias": np.zeros(cout, np.float32)},
+                "conv2": {"kernel": conv(cout, cout)},
+                "bn2": {"scale": np.ones(cout, np.float32),
+                        "bias": np.zeros(cout, np.float32)},
+            }
+            if block == 0 and cin != cout:
+                tree[name]["downsample"] = {"kernel": conv(cin, cout, 1)}
+            cin = cout
+    tree["fc"] = {"kernel": rng.standard_normal((fc_in, 1000)).astype(np.float32),
+                  "bias": np.zeros(1000, np.float32)}
+    return tree
+
+
+def tree_nbytes(tree) -> int:
+    import jax
+
+    return sum(np.asarray(x).nbytes for x in jax.tree_util.tree_leaves(tree))
+
+
+def _time(fn, reps: int) -> float:
+    """Best-of-reps seconds (min filters scheduler noise on loopback)."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_codec(tree, reps: int):
+    from elephas_tpu.parameter import wire
+
+    nbytes = tree_nbytes(tree)
+    mb = nbytes / 1e6
+    rows = []
+
+    packed_buf = wire.encode_tree(tree).tobytes()
+    pickle_buf = wire.encode_pickle(tree)
+
+    arms = [
+        ("packed", "encode", None, lambda: wire.encode_tree(tree)),
+        ("pickle", "encode", None, lambda: wire.encode_pickle(tree)),
+        ("packed", "decode", None, lambda: wire.decode(packed_buf)),
+        ("pickle", "decode", None, lambda: wire.decode_pickle(pickle_buf)),
+        ("packed", "encode", "bf16",
+         lambda: wire.encode_tree(tree, quantize="bf16")),
+        ("packed", "encode", "f16",
+         lambda: wire.encode_tree(tree, quantize="f16")),
+    ]
+    for codec, op, quantize, fn in arms:
+        secs = _time(fn, reps)
+        wire_bytes = nbytes
+        if quantize:
+            wire_bytes = wire.encode_tree(tree, quantize=quantize).nbytes
+        rows.append({
+            "mode": "codec", "codec": codec, "op": op, "quantize": quantize,
+            "tree_mb": round(mb, 2), "wire_mb": round(wire_bytes / 1e6, 2),
+            "secs": secs, "mb_per_s": round(mb / secs, 1),
+        })
+    return rows
+
+
+def bench_cache(tree, reps: int):
+    from elephas_tpu.parameter import wire
+    from elephas_tpu.parameter.server import HttpServer
+
+    full = wire.encode_tree(tree, version=0).nbytes
+    notmod = wire.encode_not_modified(0).nbytes
+    rows = [{
+        "mode": "cache", "miss_bytes": full, "hit_bytes": notmod,
+        "ratio": round(full / notmod, 1),
+    }]
+
+    server = HttpServer(tree, lock=True, port=0)
+    server.start()
+    try:
+        client = server.client()
+        client.get_parameters()  # prime: snapshot cache + client version
+        hit = _time(client.get_parameters, reps)  # unchanged → not-modified
+
+        def miss():
+            server.buffer._version += 1  # invalidate without re-training
+            client.get_parameters()
+
+        miss_secs = _time(miss, reps)
+        rows.append({
+            "mode": "cache", "op": "pull_latency",
+            "hit_secs": hit, "miss_secs": miss_secs,
+            "speedup": round(miss_secs / hit, 1),
+        })
+    finally:
+        server.stop()
+    return rows
+
+
+def bench_transport(tree, reps: int):
+    from elephas_tpu.parameter.client import HttpClient
+    from elephas_tpu.parameter.server import HttpServer
+
+    mb = tree_nbytes(tree) / 1e6
+    rows = []
+    for codec in ("packed", "pickle"):
+        server = HttpServer(tree, lock=True, port=0)
+        server.start()
+        try:
+            client = HttpClient(f"127.0.0.1:{server.port}", codec=codec)
+
+            def unit():
+                # version bump forces a full-body pull (no cache hit):
+                # this arm measures codec throughput, not the cache.
+                server.buffer._version += 1
+                pulled = client.get_parameters()
+                client.update_parameters(pulled)
+
+            secs = _time(unit, reps)
+            rows.append({
+                "mode": "transport", "codec": codec, "tree_mb": round(mb, 2),
+                "secs_per_roundtrip": secs,
+                "mb_per_s": round(2 * mb / secs, 1),  # pull + push
+            })
+        finally:
+            server.stop()
+    return rows
+
+
+def bench_pipeline(tree, units: int, train_ms: float):
+    """Per-unit wall time: serial pull→train→push vs pipelined comms."""
+    from elephas_tpu.engine.async_engine import _CommsPipeline
+    from elephas_tpu.parameter.server import HttpServer
+
+    rows = []
+    for pipelined in (False, True):
+        server = HttpServer(tree, lock=True, port=0)
+        server.start()
+        try:
+            client = server.client()
+            comms = _CommsPipeline(client, 0, max_push_attempts=3) \
+                if pipelined else None
+            t0 = time.perf_counter()
+            for _ in range(units):
+                server.buffer._version += 1  # force full-body pulls
+                if comms is not None:
+                    pulled = comms.pull()
+                    comms.prefetch()
+                else:
+                    pulled = client.get_parameters()
+                time.sleep(train_ms / 1e3)  # stand-in for the train step
+                if comms is not None:
+                    comms.push(pulled)
+                else:
+                    client.update_parameters(pulled)
+            if comms is not None:
+                comms.flush()
+                comms.close()
+            total = time.perf_counter() - t0
+            rows.append({
+                "mode": "pipeline", "pipelined": pipelined, "units": units,
+                "train_ms": train_ms,
+                "secs_per_unit": total / units,
+                "wire_overhead_ms": round(
+                    (total / units - train_ms / 1e3) * 1e3, 2),
+            })
+        finally:
+            server.stop()
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--units", type=int, default=30)
+    ap.add_argument("--train-ms", type=float, default=25.0)
+    ap.add_argument("--small", action="store_true",
+                    help="1/8-width tree (tier-1 smoke)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    tree = resnet18_tree(small=args.small)
+    n_params = tree_nbytes(tree) // 4
+    rows = [{"mode": "meta", "params": n_params,
+             "tree_mb": round(tree_nbytes(tree) / 1e6, 2),
+             "small": args.small}]
+    rows += bench_codec(tree, args.reps)
+    rows += bench_cache(tree, args.reps)
+    rows += bench_transport(tree, args.reps)
+    rows += bench_pipeline(tree, args.units, args.train_ms)
+
+    for row in rows:
+        print(json.dumps(row))
+    if args.out:
+        with open(args.out, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,224 @@
+"""Unit tests for the pipelined comms thread (engine/async_engine.py's
+``_CommsPipeline``): FIFO delta ordering, prefetch consumption, the
+bounded-queue backpressure, and the retry/fail-fast contract mirrored
+from ``run_unit`` (transient push failures retry the SAME delta —
+at-least-once, so double-apply is possible; ``ParameterServerUnavailable``
+is fatal and never retried).
+"""
+
+import threading
+import time
+
+import pytest
+
+from elephas_tpu import obs
+from elephas_tpu.engine.async_engine import _CommsPipeline
+from elephas_tpu.parameter.client import ParameterServerUnavailable
+
+
+class FakeClient:
+    """Records wire traffic; scriptable failures.
+
+    ``push_failures`` maps a delta value to a list of exceptions raised
+    on successive attempts (popped front-first). When
+    ``record_before_raise`` is set, the delta is recorded BEFORE the
+    exception — modelling a push that applied server-side but whose ack
+    was lost, the scenario that makes retry at-least-once.
+    """
+
+    def __init__(self, record_before_raise=False):
+        self.pulls = 0
+        self.pushed = []
+        self.push_failures = {}
+        self.record_before_raise = record_before_raise
+        self.pull_error = None
+        self.gate = None  # threading.Event: block pushes until set
+
+    def get_parameters(self):
+        self.pulls += 1
+        if self.pull_error is not None:
+            raise self.pull_error
+        return {"w": self.pulls}
+
+    def update_parameters(self, delta):
+        if self.gate is not None:
+            assert self.gate.wait(10.0)
+        planned = self.push_failures.get(delta)
+        if planned:
+            exc = planned.pop(0)
+            if self.record_before_raise:
+                self.pushed.append(delta)
+            raise exc
+        self.pushed.append(delta)
+
+
+def _closing(pipeline):
+    class _Ctx:
+        def __enter__(self):
+            return pipeline
+
+        def __exit__(self, *exc):
+            pipeline.close()
+
+    return _Ctx()
+
+
+def test_pushes_apply_in_fifo_order():
+    client = FakeClient()
+    with _closing(_CommsPipeline(client, 0, max_push_attempts=3)) as pipe:
+        for i in range(10):
+            pipe.push(i)
+        pipe.flush()
+    assert client.pushed == list(range(10))
+
+
+def test_prefetch_is_consumed_by_next_pull():
+    client = FakeClient()
+    with _closing(_CommsPipeline(client, 0, max_push_attempts=3)) as pipe:
+        pipe.prefetch()
+        pipe.prefetch()  # no-op while one is pending
+        first = pipe.pull()
+        assert first == {"w": 1}
+        assert client.pulls == 1  # double prefetch did not double pull
+        assert pipe.pull() == {"w": 2}  # no prefetch pending → sync pull
+
+
+def test_pull_orders_after_earlier_pushes():
+    """A prefetch enqueued after pushes must observe them (single FIFO
+    thread): the pull happens only once the deltas went out."""
+    client = FakeClient()
+    with _closing(_CommsPipeline(client, 0, max_push_attempts=3)) as pipe:
+        pipe.push("d0")
+        pipe.push("d1")
+        pipe.prefetch()
+        pipe.pull()
+        assert client.pushed == ["d0", "d1"]
+
+
+def test_transient_push_failure_retries_same_delta():
+    client = FakeClient(record_before_raise=True)
+    client.push_failures["d0"] = [RuntimeError("flake"), RuntimeError("flake")]
+    before = obs.default_registry().counter("ps_push_retry_total").value
+    with _closing(_CommsPipeline(client, 0, max_push_attempts=4)) as pipe:
+        pipe.push("d0")
+        pipe.flush()
+    # Applied on every attempt: the double-push (at-least-once) contract.
+    assert client.pushed == ["d0", "d0", "d0"]
+    after = obs.default_registry().counter("ps_push_retry_total").value
+    assert after - before == 2
+
+
+def test_push_retries_exhausted_becomes_fatal():
+    client = FakeClient()
+    client.push_failures["d0"] = [RuntimeError("flake")] * 2
+    pipe = _CommsPipeline(client, 0, max_push_attempts=2)
+    try:
+        pipe.push("d0")
+        with pytest.raises(RuntimeError, match="flake"):
+            pipe.flush()
+        with pytest.raises(RuntimeError, match="flake"):
+            pipe.push("d1")
+    finally:
+        pipe.close()
+    assert client.pushed == []  # d1 never reached the wire
+
+
+def test_ps_unavailable_push_is_fatal_not_retried():
+    client = FakeClient()
+    client.push_failures["d0"] = [
+        ParameterServerUnavailable("ps dead"),
+        ParameterServerUnavailable("ps dead"),
+    ]
+    pipe = _CommsPipeline(client, 0, max_push_attempts=5)
+    try:
+        pipe.push("d0")
+        with pytest.raises(ParameterServerUnavailable):
+            pipe.flush()
+    finally:
+        pipe.close()
+    # Exactly ONE attempt consumed: fail-fast, no retry of infra death.
+    assert len(client.push_failures["d0"]) == 1
+
+
+def test_ps_unavailable_pull_surfaces_and_poisons():
+    client = FakeClient()
+    client.pull_error = ParameterServerUnavailable("ps dead")
+    pipe = _CommsPipeline(client, 0, max_push_attempts=3)
+    try:
+        pipe.prefetch()
+        with pytest.raises(ParameterServerUnavailable):
+            pipe.pull()
+        with pytest.raises(ParameterServerUnavailable):
+            pipe.push("d0")  # subsequent ops re-raise the recorded fatal
+    finally:
+        pipe.close()
+
+
+def test_transient_pull_failure_is_not_fatal():
+    """Pull retry belongs to run_unit: the error surfaces once and the
+    pipeline keeps working."""
+    client = FakeClient()
+    client.pull_error = RuntimeError("flake")
+    pipe = _CommsPipeline(client, 0, max_push_attempts=3)
+    try:
+        with pytest.raises(RuntimeError, match="flake"):
+            pipe.pull()
+        client.pull_error = None
+        assert pipe.pull() == {"w": 2}
+        pipe.push("d0")
+        pipe.flush()
+        assert client.pushed == ["d0"]
+    finally:
+        pipe.close()
+
+
+def test_bounded_queue_applies_backpressure():
+    client = FakeClient()
+    client.gate = threading.Event()  # wedge the wire
+    pipe = _CommsPipeline(client, 0, max_push_attempts=3)
+    n_target = 8
+    enqueued = []
+
+    def producer():
+        for i in range(n_target):
+            pipe.push(i)
+            enqueued.append(i)
+
+    t = threading.Thread(target=producer, daemon=True)
+    try:
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while len(enqueued) < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)  # let it overrun the bound if it were unbounded
+        # Wire wedged: the producer must be blocked well short of
+        # n_target (1 in-flight + queue maxsize + 1 in push()).
+        assert len(enqueued) < n_target
+        client.gate.set()
+        t.join(10.0)
+        assert not t.is_alive()
+        pipe.flush()
+        assert client.pushed == list(range(n_target))
+    finally:
+        client.gate.set()
+        pipe.close()
+
+
+def test_flush_waits_for_all_pushes_not_prefetch():
+    client = FakeClient()
+    with _closing(_CommsPipeline(client, 0, max_push_attempts=3)) as pipe:
+        for i in range(3):
+            pipe.push(i)
+        pipe.prefetch()
+        pipe.flush()
+        assert client.pushed == [0, 1, 2]
+        assert pipe.pull() is not None  # prefetch still consumable
+
+
+def test_close_is_idempotent_and_safe_after_fatal():
+    client = FakeClient()
+    client.push_failures["d0"] = [ParameterServerUnavailable("ps dead")]
+    pipe = _CommsPipeline(client, 0, max_push_attempts=3)
+    pipe.push("d0")
+    pipe.close()
+    pipe.close()  # second close is a no-op, not an error

@@ -108,6 +108,72 @@ def test_host_sync_module_is_sanctioned(tmp_path):
     assert violations[0].path.endswith("other.py")
 
 
+def test_parameter_package_has_no_stray_pickle():
+    """THE pickle invariant: wire.py is the only module in
+    elephas_tpu/parameter/ allowed to call pickle — a dumps/loads added
+    anywhere else reintroduces the full-copy hot path the packed codec
+    removed, and fails tier-1 here."""
+    root = Path(lint.__file__).resolve().parent.parent / \
+        "elephas_tpu" / "parameter"
+    assert root.is_dir()
+    violations = lint.lint_pickle_package(root)
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_pickle_lint_catches_each_form(tmp_path):
+    bad = tmp_path / "bad_pickle.py"
+    bad.write_text(textwrap.dedent("""
+        import pickle
+        from pickle import loads as from_wire
+
+        def f(tree, buf):
+            a = pickle.dumps(tree)
+            b = pickle.loads(buf)
+            pickle.dump(tree, open("/dev/null", "wb"))
+            c = pickle.load(open("/dev/null", "rb"))
+            d = from_wire(buf)
+            return a, b, c, d
+    """))
+    calls = sorted(v.call for v in lint.lint_pickle_file(bad))
+    assert calls == [
+        "pickle.dump", "pickle.dumps", "pickle.from_wire", "pickle.load",
+        "pickle.loads",
+    ]
+    msg = str(lint.lint_pickle_file(bad)[0])
+    assert "wire.encode_pickle" in msg
+
+
+def test_pickle_lint_ignores_unrelated_names(tmp_path):
+    """`pickle` as a variable, `.loads` on other objects, and the pragma
+    escape all pass."""
+    ok = tmp_path / "ok_pickle.py"
+    ok.write_text(textwrap.dedent("""
+        import json
+        import pickle
+
+        def f(buf, cache):
+            a = json.loads(buf)
+            b = cache.dumps()
+            c = pickle.loads(buf)  # pickle-ok: local checkpoint, not wire
+            return a, b, c
+    """))
+    assert lint.lint_pickle_file(ok) == []
+
+
+def test_pickle_sanctioned_module_is_wire(tmp_path):
+    pkg = tmp_path / "parameter"
+    pkg.mkdir()
+    (pkg / "wire.py").write_text(
+        "import pickle\ndef enc(o):\n    return pickle.dumps(o)\n"
+    )
+    (pkg / "client.py").write_text(
+        "import pickle\ndef dec(b):\n    return pickle.loads(b)\n"
+    )
+    violations = lint.lint_pickle_package(pkg)
+    assert len(violations) == 1
+    assert violations[0].path.endswith("client.py")
+
+
 def test_cli_reports_clean(capsys):
     assert lint.main([]) == []
     assert "clean" in capsys.readouterr().out

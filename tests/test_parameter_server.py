@@ -297,6 +297,170 @@ def test_wire_servers_bind_loopback_by_default():
         assert srv2.host == "0.0.0.0"
 
 
+def test_buffer_get_with_version_and_set_bump():
+    buf = ParameterBuffer(_params(), lock=True)
+    ver0, snap0 = buf.get_numpy_with_version()
+    assert ver0 == 0
+    np.testing.assert_allclose(snap0["dense"]["w"], 1.0)
+    delta = {"dense": {"w": np.full((4, 4), 0.25, np.float32),
+                       "b": np.zeros(4, np.float32)}}
+    buf.apply_delta(delta)
+    ver1, snap1 = buf.get_numpy_with_version()
+    assert ver1 == 1
+    np.testing.assert_allclose(snap1["dense"]["w"], 0.75)
+    buf.set(_params())  # set() must ALSO invalidate version-gated caches
+    assert buf.version == 2
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_packed_pull_uses_not_modified_cache(server_cls):
+    """Second pull of an unchanged buffer must be answered by the tiny
+    not-modified frame (counted in ps_cache_hit_total), and apply_delta
+    must invalidate: the next pull carries the full fresh tree."""
+    from elephas_tpu import obs
+
+    hit_counter = obs.default_registry().counter("ps_cache_hit_total")
+    server = server_cls(_params(), lock=True, port=0)
+    server.start()
+    try:
+        client = server.client()
+        first = client.get_parameters()
+        np.testing.assert_allclose(first["dense"]["w"], 1.0)
+        before = hit_counter.value
+        second = client.get_parameters()  # unchanged → not-modified reply
+        assert hit_counter.value == before + 1
+        np.testing.assert_allclose(second["dense"]["w"], 1.0)
+
+        delta = {"dense": {"w": np.full((4, 4), 0.5, np.float32),
+                           "b": np.zeros(4, np.float32)}}
+        client.update_parameters(delta)  # bumps version → cache invalid
+        third = client.get_parameters()
+        np.testing.assert_allclose(third["dense"]["w"], 0.5)
+        assert hit_counter.value == before + 1  # full body, not a hit
+        if hasattr(client, "close"):
+            client.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_packed_roundtrip_is_bit_exact(server_cls):
+    """The default packed codec must move arbitrary float bits exactly
+    (async/hogwild numerical equivalence depends on it)."""
+    rng = np.random.default_rng(7)
+    params = {"dense": {"w": rng.normal(size=(4, 4)).astype(np.float32),
+                        "b": rng.normal(size=(4,)).astype(np.float32)}}
+    server = server_cls(params, lock=True, port=0)
+    server.start()
+    try:
+        client = server.client()
+        pulled = client.get_parameters()
+        np.testing.assert_array_equal(pulled["dense"]["w"], params["dense"]["w"])
+        np.testing.assert_array_equal(pulled["dense"]["b"], params["dense"]["b"])
+        delta = {"dense": {"w": rng.normal(size=(4, 4)).astype(np.float32),
+                           "b": np.zeros(4, np.float32)}}
+        client.update_parameters(delta)
+        np.testing.assert_array_equal(
+            client.get_parameters()["dense"]["w"],
+            params["dense"]["w"] - delta["dense"]["w"])
+        if hasattr(client, "close"):
+            client.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_legacy_pickle_client_interop(server_cls):
+    """codec='pickle' clients (stand-ins for pre-wire peers) speak the
+    legacy protocol against the NEW servers: pulls and pushes both work."""
+    from elephas_tpu.parameter.client import HttpClient, SocketClient
+
+    server = server_cls(_params(), lock=True, port=0)
+    server.start()
+    try:
+        cls = HttpClient if server_cls is HttpServer else SocketClient
+        legacy = cls(f"127.0.0.1:{server.port}", codec="pickle")
+        pulled = legacy.get_parameters()
+        np.testing.assert_allclose(pulled["dense"]["w"], 1.0)
+        delta = {"dense": {"w": np.full((4, 4), 0.5, np.float32),
+                           "b": np.zeros(4, np.float32)}}
+        legacy.update_parameters(delta)
+        np.testing.assert_allclose(legacy.get_parameters()["dense"]["w"], 0.5)
+        # Packed and pickle clients see the SAME buffer state.
+        packed = server.client()
+        np.testing.assert_allclose(packed.get_parameters()["dense"]["w"], 0.5)
+        for c in (legacy, packed):
+            if hasattr(c, "close"):
+                c.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_quantized_push_applies_approximately(server_cls):
+    server = server_cls(_params(), lock=True, port=0)
+    server.start()
+    try:
+        client = server.client()
+        client.push_quantize = None  # construct via factory arg instead
+        from elephas_tpu.parameter.client import HttpClient, SocketClient
+
+        cls = HttpClient if server_cls is HttpServer else SocketClient
+        qclient = cls(f"127.0.0.1:{server.port}", push_quantize="bf16")
+        delta = {"dense": {"w": np.full((4, 4), 0.5, np.float32),
+                           "b": np.zeros(4, np.float32)}}
+        qclient.update_parameters(delta)
+        out = qclient.get_parameters()
+        np.testing.assert_allclose(out["dense"]["w"], 0.5, rtol=1e-2)
+        for c in (client, qclient):
+            if hasattr(c, "close"):
+                c.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_authenticated_packed_roundtrip(server_cls):
+    """HMAC + packed codec compose: scatter-gather frames are MAC'd
+    chunk-wise and verified before decode."""
+    key = b"p" * 32
+    server = server_cls(_params(), lock=True, port=0, auth_key=key)
+    server.start()
+    try:
+        client = server.client()
+        np.testing.assert_allclose(client.get_parameters()["dense"]["w"], 1.0)
+        delta = {"dense": {"w": np.full((4, 4), 0.5, np.float32),
+                           "b": np.zeros(4, np.float32)}}
+        client.update_parameters(delta)
+        np.testing.assert_allclose(client.get_parameters()["dense"]["w"], 0.5)
+        # Cached not-modified path works under auth too.
+        np.testing.assert_allclose(client.get_parameters()["dense"]["w"], 0.5)
+        if hasattr(client, "close"):
+            client.close()
+    finally:
+        server.stop()
+
+
+def test_ps_byte_counters_move():
+    from elephas_tpu import obs
+
+    reg = obs.default_registry()
+    tx0 = reg.counter("ps_bytes_tx").value
+    rx0 = reg.counter("ps_bytes_rx").value
+    server = HttpServer(_params(), lock=True, port=0)
+    server.start()
+    try:
+        client = server.client()
+        client.get_parameters()
+        assert reg.counter("ps_bytes_tx").value > tx0  # pull left the server
+        delta = {"dense": {"w": np.full((4, 4), 0.5, np.float32),
+                           "b": np.zeros(4, np.float32)}}
+        client.update_parameters(delta)
+        assert reg.counter("ps_bytes_rx").value > rx0  # push reached it
+    finally:
+        server.stop()
+
+
 def test_prob_losses_match_logit_losses():
     import jax.numpy as jnp
     from elephas_tpu.engine.losses import LOSSES

@@ -1,0 +1,229 @@
+"""Property tests for the packed PS wire codec (parameter/wire.py).
+
+The codec is the PS hot path's foundation: every pull/push crosses it,
+so round-trip fidelity (exact bytes for the unquantized path, bounded
+error for quantized deltas), structure preservation (including empty
+subtrees, which path-list encodings silently drop), and loud failure on
+malformed frames are all tier-1 invariants.
+"""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+
+import jax
+
+from elephas_tpu.parameter import wire
+from elephas_tpu.utils.sockets import MAGIC_NOTMOD, MAGIC_TREE
+
+
+def _roundtrip(tree, **encode_kw):
+    frames = wire.encode_tree(tree, **encode_kw)
+    return wire.decode(frames.tobytes())
+
+
+def _assert_trees_equal(got, want):
+    jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), got, want))
+    assert jax.tree_util.tree_structure(got) == \
+        jax.tree_util.tree_structure(want)
+
+
+# -- round trips --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32", "uint8"])
+def test_roundtrip_exact_per_dtype(dtype):
+    rng = np.random.default_rng(0)
+    tree = {
+        "dense": {"kernel": rng.normal(size=(17, 5)).astype(dtype),
+                  "bias": rng.normal(size=(5,)).astype(dtype)},
+        "stack": [rng.normal(size=(3, 3, 2)).astype(dtype)],
+    }
+    out = _roundtrip(tree)
+    _assert_trees_equal(out.tree, tree)
+    for leaf in jax.tree_util.tree_leaves(out.tree):
+        assert leaf.dtype == np.dtype(dtype)
+
+
+def test_roundtrip_bf16_leaves():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)
+    tree = {"w": arr.astype(ml_dtypes.bfloat16)}
+    out = _roundtrip(tree)
+    assert out.tree["w"].dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(out.tree["w"], dtype=np.float32),
+        np.asarray(tree["w"], dtype=np.float32))
+
+
+def test_roundtrip_scalars_and_0d():
+    tree = {"step": np.int64(7), "lr": np.float32(0.125),
+            "zero_d": np.array(3.0, dtype=np.float32)}
+    out = _roundtrip(tree).tree
+    assert int(out["step"]) == 7
+    assert float(out["lr"]) == 0.125
+    assert np.shape(out["zero_d"]) == ()
+
+
+def test_roundtrip_empty_subtrees_and_none():
+    """The skeleton must carry structure pickle carries: empty dicts,
+    empty lists, None leaves — a path-list encoding would collapse
+    ``{"a": {}}`` into ``{}``."""
+    tree = {"a": {}, "b": [], "c": None,
+            "d": (np.ones((2,), np.float32), {"nested_empty": {}})}
+    out = _roundtrip(tree).tree
+    assert out["a"] == {}
+    assert out["b"] == []
+    assert out["c"] is None
+    assert isinstance(out["d"], tuple)
+    assert out["d"][1] == {"nested_empty": {}}
+    np.testing.assert_array_equal(out["d"][0], np.ones((2,), np.float32))
+
+
+def test_roundtrip_zero_length_leaf():
+    out = _roundtrip({"empty": np.zeros((0, 4), np.float32)}).tree
+    assert out["empty"].shape == (0, 4)
+
+
+def test_version_travels_in_header():
+    frames = wire.encode_tree({"w": np.ones(3, np.float32)}, version=41)
+    assert wire.decode(frames.tobytes()).version == 41
+    assert wire.decode(
+        wire.encode_tree({"w": np.ones(3, np.float32)}).tobytes()
+    ).version is None
+
+
+def test_decode_is_zero_copy_views():
+    buf = wire.encode_tree({"w": np.arange(8, dtype=np.float32)}).tobytes()
+    leaf = wire.decode(buf).tree["w"]
+    assert not leaf.flags.writeable  # frombuffer view of the frame
+    assert leaf.base is not None
+
+
+def test_payload_is_64b_aligned():
+    frames = wire.encode_tree({
+        "a": np.ones((3,), np.uint8),  # 3B leaf forces inter-leaf pad
+        "b": np.ones((4,), np.float32),
+    })
+    buf = frames.tobytes()
+    (hlen,) = struct.unpack_from("!I", buf, 4)
+    header = json.loads(buf[8:8 + hlen])
+    assert (8 + hlen) % 64 == 0
+    for _, _, offset, _, _, _ in header["leaves"]:
+        assert offset % 64 == 0
+
+
+# -- quantization -------------------------------------------------------------
+
+
+def test_quantize_bf16_halves_bytes_and_bounds_error():
+    pytest.importorskip("ml_dtypes")
+    rng = np.random.default_rng(1)
+    arr = rng.normal(size=(64, 64)).astype(np.float32)
+    plain = wire.encode_tree({"w": arr})
+    quant = wire.encode_tree({"w": arr}, quantize="bf16")
+    assert quant.nbytes < plain.nbytes * 0.75
+    out = wire.decode(quant.tobytes()).tree["w"]
+    assert out.dtype == np.float32  # restored to the original dtype
+    # bf16 keeps f32's exponent: relative error bounded by 2^-8.
+    np.testing.assert_allclose(out, arr, rtol=2.0 ** -7, atol=1e-6)
+
+
+def test_quantize_f16_scales_large_deltas():
+    """Per-leaf scaling must keep values that overflow float16 finite."""
+    arr = np.array([1.0e6, -2.0e6, 3.5], dtype=np.float32)
+    out = wire.decode(
+        wire.encode_tree({"w": arr}, quantize="f16").tobytes()).tree["w"]
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out, arr, rtol=2e-3, atol=2e-3 * 2.0e6)
+
+
+def test_quantize_skips_int_and_half_leaves():
+    tree = {"counts": np.arange(5, dtype=np.int32),
+            "half": np.ones(5, dtype=np.float16)}
+    out = wire.decode(
+        wire.encode_tree(tree, quantize="bf16").tobytes()).tree
+    np.testing.assert_array_equal(out["counts"], tree["counts"])
+    assert out["counts"].dtype == np.int32
+    assert out["half"].dtype == np.float16
+
+
+def test_quantize_unknown_mode_raises():
+    with pytest.raises(wire.WireFormatError):
+        wire.encode_tree({"w": np.ones(3, np.float32)}, quantize="int4")
+
+
+# -- not-modified frames ------------------------------------------------------
+
+
+def test_not_modified_is_12_bytes_roundtrip():
+    frames = wire.encode_not_modified(123456789)
+    buf = frames.tobytes()
+    assert len(buf) == 12 and buf.startswith(MAGIC_NOTMOD)
+    out = wire.decode(buf)
+    assert isinstance(out, wire.NotModified)
+    assert out.version == 123456789
+
+
+def test_decode_payload_rejects_not_modified():
+    with pytest.raises(wire.WireFormatError):
+        wire.decode_payload(wire.encode_not_modified(1).tobytes())
+
+
+# -- negotiation & failure modes ----------------------------------------------
+
+
+def test_is_packed_distinguishes_pickle():
+    packed = wire.encode_tree({"w": np.ones(2, np.float32)}).tobytes()
+    legacy = wire.encode_pickle({"w": np.ones(2, np.float32)})
+    assert wire.is_packed(packed)
+    assert wire.is_packed(wire.encode_not_modified(0).tobytes())
+    assert not wire.is_packed(legacy)
+    assert legacy[:1] == b"\x80"  # protocol>=2 opcode, disjoint from magics
+
+
+def test_decode_payload_handles_both_codecs():
+    tree = {"w": np.arange(6, dtype=np.float32)}
+    for body in (wire.encode_tree(tree).tobytes(), wire.encode_pickle(tree)):
+        np.testing.assert_array_equal(
+            wire.decode_payload(body)["w"], tree["w"])
+
+
+def test_treedef_mismatch_raises():
+    tree = {"w": np.ones(3, np.float32)}
+    buf = wire.encode_tree(tree).tobytes()
+    wrong = jax.tree_util.tree_structure({"w": 0, "extra": 0})
+    with pytest.raises(wire.WireFormatError, match="treedef mismatch"):
+        wire.decode(buf, expect_treedef=wrong)
+    ok = jax.tree_util.tree_structure(tree)
+    assert wire.decode(buf, expect_treedef=ok).tree is not None
+
+
+def test_unsupported_structures_fall_to_pickle():
+    """Non-JSON dict keys and object leaves raise WireFormatError so
+    callers can fall back to encode_pickle."""
+    with pytest.raises(wire.WireFormatError):
+        wire.encode_tree({("tuple", "key"): np.ones(2, np.float32)})
+    with pytest.raises(wire.WireFormatError):
+        wire.encode_tree({"w": np.array([object()])})
+
+
+@pytest.mark.parametrize("mangle", [
+    lambda b: b[:6],                               # truncated header
+    lambda b: b[:len(b) - 8],                      # truncated payload
+    lambda b: MAGIC_TREE + b"\x00\x00\x00\x04junk" + b[12:],  # bad JSON
+    lambda b: b"WHAT" + b[4:],                     # unknown magic
+])
+def test_malformed_frames_raise_wire_errors(mangle):
+    good = wire.encode_tree({"w": np.arange(32, dtype=np.float32)}).tobytes()
+    with pytest.raises(wire.WireFormatError):
+        wire.decode(mangle(good))
+
+
+def test_frames_nbytes_matches_tobytes():
+    frames = wire.encode_tree(
+        {"a": np.ones((5, 5), np.float32), "b": np.ones(3, np.uint8)})
+    assert frames.nbytes == len(frames.tobytes())
