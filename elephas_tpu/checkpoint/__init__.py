@@ -12,6 +12,8 @@ delegation to Spark fault tolerance.
 
 from elephas_tpu.checkpoint.checkpoint import (  # noqa: F401
     CheckpointManager,
+    NoCheckpointError,
+    latest_step,
     restore_train_state,
     save_train_state,
 )

@@ -15,6 +15,39 @@ import orbax.checkpoint as ocp
 from elephas_tpu.engine.state import TrainState
 
 
+class NoCheckpointError(FileNotFoundError):
+    """No restorable checkpoint exists under the given directory.
+
+    Raised by every restore path here (and by the PS warm-restart WAL,
+    ``resilience.wal.SnapshotWAL.restore_latest``) instead of an Orbax
+    traceback or a raw ``FileNotFoundError``, so "cold start" is one
+    clearly-named branch for callers:
+
+        try:
+            state = manager.restore(target)
+        except NoCheckpointError:
+            state = cold_init()
+
+    Subclasses ``FileNotFoundError`` so pre-existing handlers keep
+    working.
+    """
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Highest numbered snapshot step under ``directory``, or None.
+
+    Module-level (no manager construction, no Orbax handshake): a
+    filename scan of the ``<directory>/<step>/`` layout both the
+    rotating manager and the one-shot savers write. Use it to decide
+    cheaply whether a resume is possible before building anything."""
+    directory = os.path.abspath(directory)
+    try:
+        steps = [int(d) for d in os.listdir(directory) if d.isdigit()]
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    return max(steps) if steps else None
+
+
 class CheckpointManager:
     """Rotating snapshot manager + fit-callback factory.
 
@@ -65,7 +98,10 @@ class CheckpointManager:
     def restore(self, target: TrainState, step: Optional[int] = None) -> TrainState:
         step = self.latest_step() if step is None else step
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+            raise NoCheckpointError(
+                f"no checkpoints under {self.directory} (cold start: "
+                "initialize fresh state instead of restoring)"
+            )
         return self._manager.restore(step, args=ocp.args.StandardRestore(target))
 
     def callback(self):
@@ -106,9 +142,11 @@ def restore_train_state(directory: str, target: TrainState, step: Optional[int] 
     """One-shot restore; picks the highest-numbered step if unspecified."""
     directory = os.path.abspath(directory)
     if step is None:
-        steps = [int(d) for d in os.listdir(directory) if d.isdigit()]
-        if not steps:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
-        step = max(steps)
+        step = latest_step(directory)
+        if step is None:
+            raise NoCheckpointError(
+                f"no checkpoints under {directory} (cold start: "
+                "initialize fresh state instead of restoring)"
+            )
     ckptr = ocp.StandardCheckpointer()
     return ckptr.restore(os.path.join(directory, str(step)), target)

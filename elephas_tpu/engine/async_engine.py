@@ -103,8 +103,18 @@ class _CommsPipeline:
     deliberately does not wait on a pending prefetch.
     """
 
-    def __init__(self, client, worker_index: int, max_push_attempts: int):
+    # Backoff between same-delta push retries: a transient server hiccup
+    # (GC pause, contended accept queue) usually clears in well under a
+    # second; retrying instantly just burns the attempt budget into the
+    # same hiccup.
+    _PUSH_RETRY_DELAYS = (0.05, 0.1, 0.2)
+
+    def __init__(self, client, worker_index: int, max_push_attempts: int,
+                 sleep=time.sleep):
+        """``sleep`` is injectable so retry/backoff tests assert the
+        schedule without real waits (tier-1 must not sleep)."""
         self._client = client
+        self._sleep = sleep
         self._max_push_attempts = max(1, max_push_attempts)
         self._queue: queue.Queue = queue.Queue(maxsize=3)
         self._fatal: Optional[BaseException] = None
@@ -223,6 +233,9 @@ class _CommsPipeline:
                     "ps_push_retry_total",
                     help="background same-delta push retries (pipelined comms)",
                 ).inc()
+                self._sleep(self._PUSH_RETRY_DELAYS[
+                    min(attempt, len(self._PUSH_RETRY_DELAYS) - 1)
+                ])
 
 
 class AsyncTrainer:
@@ -239,6 +252,11 @@ class AsyncTrainer:
         autotune: bool = False,
         stream_batches: Optional[int] = None,
         pipelined_comms: Optional[bool] = None,
+        elastic: bool = False,
+        fault_plan=None,
+        ps_wal_dir: Optional[str] = None,
+        wal_every: int = 1,
+        ps_recovery_grace: float = 15.0,
     ):
         """``pipelined_comms``: run each worker's PS traffic on a
         background comms thread (``_CommsPipeline``) — pushes become
@@ -279,7 +297,27 @@ class AsyncTrainer:
         exception (one bad batch, a flaky dispatch) retries its current
         epoch/batch unit from a FRESH parameter-server pull with a
         re-seeded RNG/shuffle stream; ``ParameterServerUnavailable`` is
-        infrastructure death, not a task fault, and is never retried."""
+        infrastructure death, not a task fault, and is never retried.
+
+        ``elastic``: run ``fit`` on the resilience layer's self-healing
+        pool (``elephas_tpu.resilience``) instead of the fixed
+        thread-per-partition loop: frequency units become ``(epoch,
+        partition)`` ledger entries leased to whichever worker is alive,
+        a dead worker's units are re-queued to survivors, late joiners
+        enter mid-epoch, and a parameter-server crash is ridden out for
+        ``ps_recovery_grace`` seconds (warm restart) instead of failing
+        the fit. Single-host, ``frequency='epoch'`` only.
+
+        ``fault_plan``: a ``resilience.FaultPlan`` — deterministic,
+        seeded chaos (dropped/delayed/duplicated wire frames, worker
+        kills/stalls at chosen unit indices) installed for the duration
+        of the fit; identical plans replay identical failure schedules.
+
+        ``ps_wal_dir``/``wal_every``: write-ahead snapshot directory for
+        the PS (wire transports): accepted pushes become durable before
+        they are acked (at most ``wal_every - 1`` versions of lag) and a
+        server constructed over the same directory warm-restarts from
+        the newest durable version."""
         if frequency not in _FREQUENCIES:
             raise ValueError(
                 f"async frequency must be batch|epoch, got {frequency!r} "
@@ -299,6 +337,21 @@ class AsyncTrainer:
             raise ValueError(f"stream_batches must be >= 1, got {stream_batches}")
         self.stream_batches = stream_batches
         self.pipelined_comms = pipelined_comms
+        if elastic and frequency != "epoch":
+            raise ValueError(
+                "elastic=True schedules (epoch, partition) ledger units, "
+                "which are epoch-granular — use frequency='epoch'"
+            )
+        self.elastic = elastic
+        self.fault_plan = fault_plan
+        self.ps_wal_dir = ps_wal_dir
+        self.wal_every = wal_every
+        self.ps_recovery_grace = ps_recovery_grace
+        # Chaos-harness handles, live during an elastic fit: the current
+        # server object (tests kill/replace it) and the worker pool
+        # (tests join late workers / inspect membership).
+        self._elastic_server = None
+        self._elastic_pool = None
         # Phase profiling (scripts/flagship_phases.py): when True, the
         # 'epoch'-frequency worker loop and the epoch fire force device
         # results at phase boundaries and append per-phase wall seconds
@@ -493,6 +546,11 @@ class AsyncTrainer:
         checkpointers (which no-op on an already-saved step) keep saving
         after a resume."""
         compiled = self.compiled
+        if self.elastic:
+            return self._fit_elastic(
+                dataset, epochs, batch_size, validation_data, verbose,
+                rng, callbacks, initial_step,
+            )
         if self.autotune and self.autotune_choice is None:
             # No `self.workers` gate: multi-host, the decision broadcast
             # inside is a collective every rank must reach.
@@ -528,6 +586,8 @@ class AsyncTrainer:
                 device=jax.local_devices()[0],
                 granularity=self.granularity,
                 auth_key=bytes.fromhex(env_key) if env_key else None,
+                wal_dir=self.ps_wal_dir,
+                wal_every=self.wal_every,
             )
             server.start()
         else:
@@ -562,6 +622,8 @@ class AsyncTrainer:
                     host=os.environ.get("ELEPHAS_PS_BIND", "0.0.0.0"),
                     granularity=self.granularity,
                     auth_key=auth_key,
+                    wal_dir=self.ps_wal_dir,
+                    wal_every=self.wal_every,
                 )
                 server.start()
             if server is not None:
@@ -943,6 +1005,289 @@ class AsyncTrainer:
         if verbose:
             last = {k: round(v[-1], 4) for k, v in history.items()}
             print(f"[{'async' if self.lock else 'hogwild'}] done: {last}")
+        return state, history
+
+    # -------------------------------------------------------------------------
+
+    def _fit_elastic(
+        self,
+        dataset,
+        epochs: int,
+        batch_size: int,
+        validation_data,
+        verbose: int,
+        rng,
+        callbacks,
+        initial_step: int,
+    ) -> Tuple[TrainState, Dict[str, List[float]]]:
+        """Elastic fit: the ledger/pool replaces the fixed worker loop.
+
+        Every ``(epoch, partition)`` unit is leased from a
+        ``resilience.UnitLedger`` to whichever worker thread is alive;
+        dead workers' in-flight units are re-queued to survivors, the
+        per-epoch fire runs when the LEDGER says the epoch is complete
+        (not when a fixed set of threads report in), and a PS crash is
+        ridden out against a warm restart on the same address. Unit
+        determinism is keyed on ``(partition, epoch)`` — NOT the worker —
+        so a re-run of a re-queued unit trains the identical shuffle and
+        dropout streams the dead worker would have.
+
+        Chaos harness surface: ``self._elastic_server`` (kill it, warm
+        restart on the same port + WAL dir, reassign the handle) and
+        ``self._elastic_pool`` (``join_worker`` for late joins,
+        ``membership`` for the published liveness table).
+        """
+        import os
+
+        from elephas_tpu.parameter.client import make_client
+        from elephas_tpu.parameter.server import _dial_host
+        from elephas_tpu.resilience import (
+            ElasticWorkerPool,
+            FaultInjector,
+            UnitLedger,
+            install,
+        )
+
+        compiled = self.compiled
+        if jax.process_count() > 1:
+            raise ValueError(
+                "elastic fit is single-host for now: one process leases "
+                "units for all of its chips (multi-host elasticity needs "
+                "a cross-host ledger)"
+            )
+        store0 = {"params": compiled.params, "batch_stats": compiled.batch_stats}
+        env_key = os.environ.get("ELEPHAS_PS_AUTH_KEY")
+        auth_key = bytes.fromhex(env_key) if env_key else None
+        server = make_server(
+            self.parameter_server_mode,
+            store0,
+            lock=self.lock,
+            port=self.port,
+            device=jax.local_devices()[0],
+            granularity=self.granularity,
+            auth_key=auth_key,
+            wal_dir=self.ps_wal_dir,
+            wal_every=self.wal_every,
+        )
+        server.start()
+        self._elastic_server = server
+
+        mode = self.parameter_server_mode
+        if mode == "local":
+            def client_factory(worker_id):
+                # In-process: a PS "restart" is impossible (the buffer
+                # dies with this process), so always the live handle.
+                return self._elastic_server.client()
+        else:
+            # Dial the ADDRESS, not the server object: after a kill +
+            # warm restart a NEW server owns the same port, and fresh
+            # clients must reach it for recovery to complete.
+            address = f"{_dial_host(server.host)}:{server.port}"
+
+            def client_factory(worker_id):
+                return make_client(mode, address, auth_key=auth_key)
+
+        injector = None
+        if self.fault_plan is not None:
+            injector = FaultInjector(self.fault_plan)
+            install(injector)
+        self._fault_injector = injector
+
+        partitions = list(range(self.n_global_workers))
+        ledger = UnitLedger(epochs, partitions)
+        worker_ids = [f"w{slot}" for slot in range(self.n_workers)]
+        devices = self.devices
+
+        def device_for(worker_id: str) -> jax.Device:
+            # Late joiners ("w<k>" beyond the initial pool, or any name)
+            # share the chip ring round-robin.
+            try:
+                i = int(str(worker_id).lstrip("w"))
+            except ValueError:
+                i = abs(hash(worker_id))
+            return devices[i % len(devices)]
+
+        data_lock = threading.Lock()
+        host_rows: Dict[int, tuple] = {}       # partition -> (x, y, nb, usable)
+        device_rows: Dict[tuple, tuple] = {}   # (worker, partition) -> arrays
+        opt_states: Dict[str, object] = {}     # worker -> local optimizer state
+
+        def partition_rows(part: int):
+            with data_lock:
+                if part not in host_rows:
+                    x, y = dataset.partition(part)
+                    nb = len(x) // batch_size
+                    if nb == 0:
+                        raise ValueError(
+                            f"partition {part}: {len(x)} rows < "
+                            f"batch_size {batch_size}"
+                        )
+                    usable = nb * batch_size
+                    host_rows[part] = (
+                        np.asarray(x[:usable]), np.asarray(y[:usable]),
+                        nb, usable,
+                    )
+                return host_rows[part]
+
+        def run_unit(worker_id: str, client, unit):
+            epoch, part = unit
+            device = device_for(worker_id)
+            x, y, nb, usable = partition_rows(part)
+            cache_key = (worker_id, part)
+            if cache_key not in device_rows:
+                device_rows[cache_key] = (
+                    jax.device_put(x, device), jax.device_put(y, device)
+                )
+            x_d, y_d = device_rows[cache_key]
+            # Unit-keyed determinism: shuffle and dropout depend only on
+            # (partition, epoch), so a survivor re-running a dead
+            # worker's unit reproduces it exactly.
+            perm = np.random.default_rng([1234, part, epoch]).permutation(usable)
+            perm_d = jax.device_put(perm, device)
+            ex = jnp.take(x_d, perm_d, axis=0).reshape(
+                nb, batch_size, *x_d.shape[1:]
+            )
+            ey = jnp.take(y_d, perm_d, axis=0).reshape(
+                nb, batch_size, *y_d.shape[1:]
+            )
+            pulled = client.get_parameters()
+            params = jax.device_put(pulled["params"], device)
+            batch_stats = jax.device_put(pulled["batch_stats"], device)
+            opt_state = opt_states.get(worker_id)
+            if opt_state is None:
+                opt_state = jax.device_put(
+                    compiled.init_opt_state(params), device
+                )
+            unit_rng = jax.random.fold_in(
+                jax.random.fold_in(self._base_rng, part), epoch
+            )
+            state0 = TrainState.create(
+                params=params,
+                opt_state=opt_state,
+                batch_stats=batch_stats,
+                rng=jax.device_put(unit_rng, device),
+                step=epoch * nb,
+            )
+            new_state, metrics = self._epoch_fn(state0, ex, ey)
+            # Force the scan BEFORE pushing — a device fault must kill
+            # this unit (re-queued by the pool), never poison the buffer.
+            fetched = {
+                k: float(v) for k, v in jax.device_get(metrics).items()
+            }
+            client.update_parameters({
+                "params": self._subtract(state0.params, new_state.params),
+                "batch_stats": self._subtract(
+                    state0.batch_stats, new_state.batch_stats
+                ),
+            })
+            opt_states[worker_id] = new_state.opt_state
+            return fetched
+
+        val_records: List[Optional[Dict[str, float]]] = [None] * epochs
+        snap_opt_state = [None]
+        run_callbacks = tuple(callbacks)
+        do_val = validation_data is not None
+
+        def on_epoch_complete(epoch: int) -> None:
+            if not run_callbacks and not do_val:
+                return
+            # Fresh client per fire: the server object may have been
+            # killed and warm-restarted since the last epoch.
+            fire_client = client_factory("fire")
+            try:
+                snapshot = fire_client.get_parameters()
+            finally:
+                fire_client.close()
+            if snap_opt_state[0] is None:
+                snap_opt_state[0] = compiled.init_opt_state(snapshot["params"])
+            snap_state = TrainState.create(
+                params=snapshot["params"],
+                opt_state=snap_opt_state[0],
+                batch_stats=snapshot["batch_stats"],
+                step=initial_step + epoch + 1,
+            )
+            if do_val:
+                val_records[epoch] = dict(
+                    self._local_evaluate(snap_state, *validation_data)
+                )
+            for cb in run_callbacks:
+                cb(epoch, snap_state, {})
+
+        pool = ElasticWorkerPool(
+            ledger,
+            run_unit,
+            client_factory,
+            worker_ids,
+            on_epoch_complete=on_epoch_complete,
+            injector=injector,
+            ps_recovery_grace=self.ps_recovery_grace,
+        )
+        self._elastic_pool = pool
+        pool.start()
+        try:
+            stats = pool.wait()
+            # Final weights through the ADDRESS (the original server
+            # handle may be a corpse the chaos harness replaced).
+            final_client = client_factory("final")
+            try:
+                final = jax.device_get(final_client.get_parameters())
+            finally:
+                final_client.close()
+        finally:
+            if injector is not None:
+                install(None)
+            self._elastic_pool = None
+            live = self._elastic_server
+            self._elastic_server = None
+            if live is not None:
+                try:
+                    live.stop()
+                except Exception:
+                    pass
+
+        self.elastic_stats = stats
+        em = pool.epoch_metrics()
+        keys = sorted(next(iter(em[0].values())).keys())
+        history: Dict[str, List[float]] = {
+            k: [
+                float(np.mean([em[e][p][k] for p in sorted(em[e])]))
+                for e in range(epochs)
+            ]
+            for k in keys
+        }
+        if do_val:
+            for epoch, val in enumerate(val_records):
+                if val is None:  # defensive; every epoch completion fires
+                    val = val_records[epoch] = dict(
+                        self._local_evaluate(
+                            TrainState.create(
+                                params=final["params"],
+                                opt_state=compiled.init_opt_state(
+                                    final["params"]
+                                ),
+                                batch_stats=final["batch_stats"],
+                                step=initial_step + epochs,
+                            ),
+                            *validation_data,
+                        )
+                    )
+                for k, v in val.items():
+                    history.setdefault(f"val_{k}", []).append(v)
+        state = TrainState.create(
+            params=final["params"],
+            opt_state=compiled.init_opt_state(final["params"]),
+            batch_stats=final["batch_stats"],
+            rng=rng if rng is not None else jax.random.PRNGKey(0),
+            step=initial_step + epochs,
+        )
+        if verbose:
+            last = {k: round(v[-1], 4) for k, v in history.items()}
+            print(
+                f"[elastic] done: {last} "
+                f"(requeued={stats['requeued_units']}, "
+                f"deaths={len(stats['worker_deaths'])}, "
+                f"late_joins={len(stats['late_joins'])})"
+            )
         return state, history
 
     # -------------------------------------------------------------------------

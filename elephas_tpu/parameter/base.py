@@ -40,3 +40,25 @@ class BaseParameterClient(abc.ABC):
     def update_parameters(self, delta) -> None:
         """Push a weight delta (``before - after``; server applies
         ``weights -= delta``, matching the reference's convention)."""
+
+    # --- liveness / control-plane surface (resilience layer) -----------
+    # Benign defaults so in-process clients and test fakes stay minimal;
+    # the wire clients override these with real PS round-trips.
+
+    def heartbeat(self, worker_id: str) -> None:
+        """Tell the PS failure detector this worker is alive."""
+
+    def membership(self) -> dict:
+        """The PS failure detector's worker table (id -> state info)."""
+        return {}
+
+    def deregister(self, worker_id: str) -> None:
+        """Graceful exit: drop the worker from the failure detector so a
+        clean shutdown is never counted as an expiry."""
+
+    def health(self) -> bool:
+        """Would a new request reach the server right now?"""
+        return True
+
+    def close(self) -> None:
+        """Release any pooled transport state (idempotent)."""

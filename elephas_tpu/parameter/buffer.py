@@ -168,7 +168,13 @@ class ParameterBuffer:
         for path, leaf_delta in flat_delta:
             store[path] = self._apply_leaf(store[path], leaf_delta)
 
-    def set(self, params) -> None:
+    def set(self, params, version: Optional[int] = None) -> None:
+        """Replace the stored tree. ``version`` (warm restart only):
+        resume the counter at a WAL snapshot's durable version instead
+        of bumping — the restarted server's version line continues where
+        the durable history left off. Stale-cache safety does NOT rest
+        on this number: version-gated pulls are additionally keyed on
+        the server's per-process boot id (``parameter/server.py``)."""
         with self._lock.writing():
             params = jax.device_put(params, self._device)
             if self._granularity == "leaf":
@@ -181,4 +187,7 @@ class ParameterBuffer:
             # set() replaces content, so it must invalidate
             # version-keyed snapshot caches exactly like apply_delta.
             with self._version_guard:
-                self._version += 1
+                if version is not None:
+                    self._version = int(version)
+                else:
+                    self._version += 1

@@ -29,10 +29,12 @@ from __future__ import annotations
 
 import hmac
 import http.client
+import json
 import os
 import socket
 import threading
 import time
+import warnings
 from typing import Optional
 
 import jax
@@ -86,27 +88,42 @@ def _encode_push(delta, codec: str, quantize: Optional[str]):
 
 class _PullCache:
     """Client side of the version-gated pull: remembers the last
-    ``(version, tree)`` a full-body reply carried, advertises the
-    version on the next pull, and resolves a not-modified reply back to
-    the cached tree. Thread-safe (the pipelined engine pulls from a
-    comms thread)."""
+    ``(boot, version, tree)`` a full-body reply carried, advertises the
+    ``(boot, version)`` position on the next pull, and resolves a
+    not-modified reply back to the cached tree. The boot id scopes the
+    version to one server life — after a PS warm restart the version
+    counter resumes an old line, so version alone could alias pre-crash
+    content (``server._new_boot_id``). Thread-safe (the pipelined engine
+    pulls from a comms thread)."""
 
-    __slots__ = ("_lock", "_version", "_tree")
+    __slots__ = ("_lock", "_version", "_tree", "_boot")
 
     def __init__(self):
         self._lock = threading.Lock()
         self._version = None
         self._tree = None
+        self._boot = None
 
     def known_version(self):
         with self._lock:
             return self._version if self._tree is not None else None
 
-    def store(self, version, tree):
+    def known(self):
+        """``(boot, version)`` to advertise, or None. Only a reply that
+        carried a boot id is advertised as a pair — against a pre-boot-id
+        server the bare version keeps the legacy wire shape."""
+        with self._lock:
+            if self._tree is None or self._version is None:
+                return None
+            if self._boot is None:
+                return self._version
+            return (self._boot, self._version)
+
+    def store(self, version, tree, boot=None):
         if version is None:
             return
         with self._lock:
-            self._version, self._tree = version, tree
+            self._version, self._tree, self._boot = version, tree, boot
 
     def resolve(self, not_modified: "wire.NotModified"):
         with self._lock:
@@ -124,7 +141,7 @@ class ParameterServerUnavailable(ConnectionError):
     """The parameter server could not be reached after retries."""
 
 
-def _retry_connect(fn, address: str, op: str):
+def _retry_connect(fn, address: str, op: str, sleep=time.sleep):
     """Run ``fn`` retrying connection-level failures with backoff.
 
     Anything that indicates the server is *gone* (refused, reset, DNS,
@@ -132,7 +149,8 @@ def _retry_connect(fn, address: str, op: str):
     application-level errors (HTTP 4xx/5xx → RuntimeError) propagate
     immediately. Callers must only pass an ``fn`` that is safe to run
     again (a pure read, or connection establishment) — see the module
-    docstring's idempotency contract.
+    docstring's idempotency contract. ``sleep`` is injectable so tests
+    assert the exact backoff schedule without real waiting.
     """
     last: Exception | None = None
     for delay in (*_RETRY_DELAYS, None):
@@ -142,7 +160,7 @@ def _retry_connect(fn, address: str, op: str):
             last = exc
         if delay is None:
             break
-        time.sleep(delay)
+        sleep(delay)
     raise ParameterServerUnavailable(
         f"parameter server at {address} unreachable during {op} "
         f"(retried {len(_RETRY_DELAYS)}x over ~{sum(_RETRY_DELAYS):.1f}s): {last}"
@@ -150,8 +168,13 @@ def _retry_connect(fn, address: str, op: str):
 
 
 class LocalClient(BaseParameterClient):
-    def __init__(self, buffer: ParameterBuffer):
+    def __init__(self, buffer: ParameterBuffer, detector=None):
+        """``detector``: the owning ``LocalServer``'s failure detector;
+        when wired, the liveness surface (heartbeat/membership/deregister)
+        is real bookkeeping even in-process — the elastic pool's monitor
+        works identically across transports."""
         self._buffer = buffer
+        self._detector = detector
 
     def get_parameters(self):
         with _ps_span("pull", "local"):
@@ -160,6 +183,17 @@ class LocalClient(BaseParameterClient):
     def update_parameters(self, delta) -> None:
         with _ps_span("push", "local"):
             self._buffer.apply_delta(delta)
+
+    def heartbeat(self, worker_id: str) -> None:
+        if self._detector is not None:
+            self._detector.beat(worker_id)
+
+    def membership(self) -> dict:
+        return {} if self._detector is None else self._detector.membership()
+
+    def deregister(self, worker_id: str) -> None:
+        if self._detector is not None:
+            self._detector.deregister(worker_id)
 
     def wait_barrier(self, tag: str, n: int, timeout: Optional[float] = None) -> None:
         pass  # in-process buffer == single host; nothing to synchronize
@@ -185,7 +219,19 @@ class _WireBarrierMixin:
         surfaces as a TimeoutError naming the barrier, not a silent hang
         (the reference relied on Spark killing the whole job)."""
         if timeout is None:
-            timeout = float(os.environ.get("ELEPHAS_BARRIER_TIMEOUT", "600"))
+            raw = os.environ.get("ELEPHAS_BARRIER_TIMEOUT", "600")
+            try:
+                timeout = float(raw)
+            except ValueError:
+                # A typo'd env var must not crash teardown at the very
+                # end of a fit — warn and take the default.
+                warnings.warn(
+                    f"ELEPHAS_BARRIER_TIMEOUT={raw!r} is not a number; "
+                    "using the 600s default",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                timeout = 600.0
         self.barrier_arrive(tag)
         deadline = time.monotonic() + timeout
         poll = 0.02
@@ -314,8 +360,14 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
             headers = None
             if self.codec == "packed":
                 headers = {"X-Elephas-Codec": "packed"}
-                known = self._pull_cache.known_version()
-                if known is not None:
+                known = self._pull_cache.known()
+                if isinstance(known, tuple):
+                    # (boot, version): the server only answers
+                    # not-modified when BOTH match — version alone can
+                    # alias a previous server life after warm restart.
+                    headers["X-Elephas-Boot"] = known[0]
+                    headers["X-Elephas-Version"] = str(known[1])
+                elif known is not None:
                     headers["X-Elephas-Version"] = str(known)
             body = self._get("/parameters", "get_parameters", headers=headers)
             # Magic negotiation: a legacy server ignores our codec header
@@ -327,7 +379,7 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
                         sp.note(codec="packed", payload_bytes=len(body),
                                 cache_hit=True)
                     return self._pull_cache.resolve(out)
-                self._pull_cache.store(out.version, out.tree)
+                self._pull_cache.store(out.version, out.tree, boot=out.boot)
                 if sp:
                     sp.note(codec="packed", payload_bytes=len(body))
                 return out.tree
@@ -359,6 +411,15 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
             ) == b"ok"
         except Exception:
             return False
+
+    def heartbeat(self, worker_id: str) -> None:
+        self._post(f"/heartbeat/{worker_id}", b"", "heartbeat")
+
+    def membership(self) -> dict:
+        return json.loads(self._get("/membership", "membership"))
+
+    def deregister(self, worker_id: str) -> None:
+        self._post(f"/deregister/{worker_id}", b"", "deregister")
 
     def barrier_arrive(self, tag: str) -> int:
         return int(self._post(f"/barrier/{tag}", b"", "barrier_arrive"))
@@ -476,7 +537,10 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
                 if sp:
                     sp.note(codec="pickle")
                 return tree
-            known = self._pull_cache.known_version()
+            # known is (boot, version) from a boot-stamping server, a
+            # bare int against legacy peers, or None on a cold cache —
+            # the server only answers not-modified for a matching pair.
+            known = self._pull_cache.known()
             reply = self._roundtrip(("G", known), "get_parameters",
                                     idempotent=True)
             if not isinstance(reply, (bytes, bytearray, memoryview)):
@@ -491,7 +555,7 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
                     sp.note(codec="packed", payload_bytes=len(reply),
                             cache_hit=True)
                 return self._pull_cache.resolve(out)
-            self._pull_cache.store(out.version, out.tree)
+            self._pull_cache.store(out.version, out.tree, boot=out.boot)
             if sp:
                 sp.note(codec="packed", payload_bytes=len(reply))
             return out.tree
@@ -536,6 +600,21 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
             return True
         except Exception:
             return False
+
+    def heartbeat(self, worker_id: str) -> None:
+        # Idempotent by nature: a duplicated beat just refreshes the same
+        # liveness timestamp, so the transparent-reconnect path is safe.
+        with self._lock:
+            self._roundtrip(("h", worker_id), "heartbeat", idempotent=True)
+
+    def membership(self) -> dict:
+        with self._lock:
+            return self._roundtrip(("m", None), "membership", idempotent=True)
+
+    def deregister(self, worker_id: str) -> None:
+        # Also idempotent: deregistering an absent worker is a no-op.
+        with self._lock:
+            self._roundtrip(("d", worker_id), "deregister", idempotent=True)
 
     def barrier_arrive(self, tag: str) -> int:
         with self._lock:

@@ -29,9 +29,12 @@ Wire-transport data path (this PR's throughput rebuild):
 from __future__ import annotations
 
 import hmac
+import json
 import os
+import socket
 import socketserver
 import threading
+import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -55,6 +58,69 @@ def _ps_counters():
     )
 
 
+def _new_boot_id() -> str:
+    """Per-server-instance boot id (random, minted at construction).
+
+    Version-gated pulls are keyed on ``(boot, version)``: a warm-restarted
+    server resumes the WAL's durable version COUNTER, so the counter alone
+    can collide with a pre-restart value a client already cached — e.g. the
+    server replays to v=41 while a client still holds pre-crash v=41
+    content that never made it into the WAL. A fresh boot id makes every
+    restart a cache miss, so the first pull after recovery always carries
+    the full body."""
+    return os.urandom(6).hex()
+
+
+def _heartbeat_timeout(explicit: Optional[float] = None) -> float:
+    """Suspect threshold for the failure detector, seconds.
+
+    Precedence: explicit argument > ``ELEPHAS_HEARTBEAT_TIMEOUT`` env >
+    5.0 default. A malformed env value warns and falls back rather than
+    crashing server construction."""
+    if explicit is not None:
+        return float(explicit)
+    raw = os.environ.get("ELEPHAS_HEARTBEAT_TIMEOUT", "5")
+    try:
+        return float(raw)
+    except ValueError:
+        warnings.warn(
+            f"ELEPHAS_HEARTBEAT_TIMEOUT={raw!r} is not a number; "
+            "using the 5.0s default",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return 5.0
+
+
+def _make_detector(heartbeat_timeout: Optional[float]):
+    """Deferred import: ``resilience`` pulls in ``parameter.client`` at
+    package-import time, so a module-level import here would make the
+    layering order-sensitive (whichever package imports first wins)."""
+    from elephas_tpu.resilience.liveness import FailureDetector
+
+    return FailureDetector(suspect_after=_heartbeat_timeout(heartbeat_timeout))
+
+
+def _attach_wal(buffer: ParameterBuffer, wal_dir: str, wal_every: int):
+    """Warm-restart ``buffer`` from the newest durable WAL snapshot and
+    return the ``WalWriter`` that keeps the log moving.
+
+    Cold start (empty/corrupt WAL directory) is the ``NoCheckpointError``
+    branch: the buffer keeps the params it was constructed with and the
+    version line starts fresh."""
+    from elephas_tpu.checkpoint.checkpoint import NoCheckpointError
+    from elephas_tpu.resilience.wal import SnapshotWAL, WalWriter
+
+    wal = SnapshotWAL(wal_dir)
+    try:
+        version, tree = wal.restore_latest()
+    except NoCheckpointError:
+        pass  # cold start: serve the constructor params
+    else:
+        buffer.set(tree, version=version)
+    return WalWriter(buffer, wal, every=wal_every)
+
+
 class _SnapshotCache:
     """Serialize once per ``ParameterBuffer.version``, outside the lock.
 
@@ -73,8 +139,9 @@ class _SnapshotCache:
     ``ParameterBuffer.get_with_version``).
     """
 
-    def __init__(self, buffer: ParameterBuffer):
+    def __init__(self, buffer: ParameterBuffer, boot: Optional[str] = None):
         self._buffer = buffer
+        self._boot = boot  # stamped into packed headers (see _new_boot_id)
         self._encode_lock = threading.Lock()
         self._entries: dict = {}  # codec -> (version, frames|bytes)
 
@@ -88,7 +155,7 @@ class _SnapshotCache:
                 return entry
             version, snap = self._buffer.get_numpy_with_version()
             if codec == "packed":
-                payload = wire.encode_tree(snap, version=version)
+                payload = wire.encode_tree(snap, version=version, boot=self._boot)
             else:
                 payload = wire.encode_pickle(snap)
             entry = (version, payload)
@@ -122,9 +189,14 @@ class LocalServer(BaseParameterServer):
     """
 
     def __init__(self, params, lock: bool = True, device: Optional[jax.Device] = None,
-                 granularity: str = "tree"):
+                 granularity: str = "tree",
+                 heartbeat_timeout: Optional[float] = None):
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
+        # Liveness bookkeeping works in-process too: the elastic pool's
+        # monitor thread polls membership through a client regardless of
+        # transport, and local-mode worker threads can still die.
+        self.detector = _make_detector(heartbeat_timeout)
 
     def start(self) -> None:
         pass
@@ -138,7 +210,7 @@ class LocalServer(BaseParameterServer):
     def client(self):
         from elephas_tpu.parameter.client import LocalClient
 
-        return LocalClient(self.buffer)
+        return LocalClient(self.buffer, detector=self.detector)
 
 
 class _BarrierBook:
@@ -180,6 +252,9 @@ class HttpServer(BaseParameterServer):
         host: Optional[str] = None,
         granularity: str = "tree",
         auth_key: Optional[bytes] = None,
+        wal_dir: Optional[str] = None,
+        wal_every: int = 1,
+        heartbeat_timeout: Optional[float] = None,
     ):
         """``auth_key``: shared HMAC-SHA256 secret. When set, every
         request must carry ``X-Elephas-Auth`` = hexmac(method + path +
@@ -190,7 +265,14 @@ class HttpServer(BaseParameterServer):
         captured response can't be replayed to a later request either.
         ``/health`` stays open (liveness probe, no pickles). Multi-host
         fits enable this by default with a DCN-broadcast secret (async
-        engine)."""
+        engine).
+
+        ``wal_dir``: write-ahead snapshot directory (``resilience.wal``).
+        Construction warm-restarts the buffer from the newest durable
+        snapshot (cold start when empty) and every accepted push is made
+        durable BEFORE it is acked, at most ``wal_every`` versions behind.
+        ``heartbeat_timeout``: failure-detector suspect threshold
+        (default ``ELEPHAS_HEARTBEAT_TIMEOUT`` or 5s; dead at 2x)."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
@@ -198,6 +280,11 @@ class HttpServer(BaseParameterServer):
         self.auth_key = auth_key
         self.replay_guard = socket_utils.ReplayGuard() if auth_key else None
         self.barriers = _BarrierBook()
+        self.boot = _new_boot_id()
+        self.detector = _make_detector(heartbeat_timeout)
+        self.wal_writer = (
+            _attach_wal(self.buffer, wal_dir, wal_every) if wal_dir else None
+        )
         self._httpd = None
         self._thread = None
 
@@ -206,7 +293,10 @@ class HttpServer(BaseParameterServer):
         barriers = self.barriers
         auth_key = self.auth_key
         replay_guard = self.replay_guard
-        cache = self._cache = _SnapshotCache(buffer)
+        boot = self.boot
+        detector = self.detector
+        wal_writer = self.wal_writer
+        cache = self._cache = _SnapshotCache(buffer, boot=boot)
         cache_hits, bytes_tx, bytes_rx = _ps_counters()
 
         class Handler(BaseHTTPRequestHandler):
@@ -283,9 +373,14 @@ class HttpServer(BaseParameterServer):
                     codec = "packed" if self.headers.get(
                         "X-Elephas-Codec") == "packed" else "pickle"
                     known = self.headers.get("X-Elephas-Version")
+                    known_boot = self.headers.get("X-Elephas-Boot")
                     version, payload = cache.frames(codec)
+                    # Not-modified requires the BOOT to match too: after a
+                    # warm restart the version counter resumes an old
+                    # line, so a bare version match could alias content
+                    # from a previous server life (see _new_boot_id).
                     if codec == "packed" and known is not None \
-                            and known == str(version):
+                            and known == str(version) and known_boot == boot:
                         payload = wire.encode_not_modified(version)
                         cache_hits.inc()
                     bytes_tx.inc(payload.nbytes if isinstance(
@@ -293,6 +388,9 @@ class HttpServer(BaseParameterServer):
                     self._reply(payload,
                                 content_type="application/octet-stream",
                                 version=version)
+                elif path == "/membership":
+                    self._reply(json.dumps(detector.membership()).encode(),
+                                content_type="application/json")
                 elif path.startswith("/barrier/"):
                     self._reply(str(barriers.count(path[len("/barrier/"):])).encode())
                 else:
@@ -311,13 +409,24 @@ class HttpServer(BaseParameterServer):
                     # one endpoint serves both codecs' pushes.
                     bytes_rx.inc(len(body))
                     buffer.apply_delta(wire.decode_payload(body))
+                    if wal_writer is not None:
+                        # Durability BEFORE the ack: once the worker sees
+                        # this reply, the delta survives a PS crash (at
+                        # most wal_every-1 trailing versions are at risk).
+                        wal_writer.after_update()
                     self._reply(b"")
+                elif path.startswith("/heartbeat/"):
+                    detector.beat(path[len("/heartbeat/"):])
+                    self._reply(b"ok")
+                elif path.startswith("/deregister/"):
+                    detector.deregister(path[len("/deregister/"):])
+                    self._reply(b"ok")
                 elif path.startswith("/barrier/"):
                     self._reply(str(barriers.arrive(path[len("/barrier/"):])).encode())
                 else:
                     self.send_error(404)
 
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._httpd = _TrackingHTTPServer((self.host, self.port), Handler)
         if self.port == 0:  # ephemeral port (tests)
             self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
@@ -326,6 +435,19 @@ class HttpServer(BaseParameterServer):
     def stop(self) -> None:
         if self._httpd is not None:
             self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self.wal_writer is not None:
+            self.wal_writer.sync()  # clean shutdown leaves zero WAL lag
+
+    def kill(self) -> None:
+        """Simulate a crash: stop accepting, sever in-flight connections,
+        and — unlike ``stop`` — do NOT sync the WAL. What survives is
+        exactly what ``after_update`` already made durable, which is the
+        contract chaos tests exercise."""
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.sever_all()
             self._httpd.server_close()
             self._httpd = None
 
@@ -351,6 +473,9 @@ class _SocketHandler(socketserver.BaseRequestHandler):
         key = self.server.auth_key  # type: ignore[attr-defined]
         guard = self.server.replay_guard  # type: ignore[attr-defined]
         cache = self.server.cache  # type: ignore[attr-defined]
+        boot = self.server.boot  # type: ignore[attr-defined]
+        detector = self.server.detector  # type: ignore[attr-defined]
+        wal_writer = self.server.wal_writer  # type: ignore[attr-defined]
         cache_hits, bytes_tx, bytes_rx = _ps_counters()
         try:
             while True:
@@ -378,6 +503,8 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                     mv = memoryview(obj)
                     bytes_rx.inc(mv.nbytes)
                     buffer.apply_delta(wire.decode_payload(mv))
+                    if wal_writer is not None:
+                        wal_writer.after_update()  # durable before the ack
                     reply(b"ok")
                     continue
 
@@ -385,15 +512,32 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                 if kind == "g":  # legacy pull → cached pickle snapshot
                     _, snap = cache.frames("pickle")
                     reply(socket_utils.RawPayload([snap]))
-                elif kind == "G":  # packed pull, payload = last-seen version
+                elif kind == "G":
+                    # Packed pull; payload is the client's last-seen
+                    # position — ``(boot, version)`` from resilient
+                    # clients, a bare int from pre-boot-id peers. A bare
+                    # version can alias a previous server life after warm
+                    # restart, so it NEVER earns a not-modified reply
+                    # (full body instead — correct, just uncached).
                     version, frames = cache.frames("packed")
-                    if payload is not None and payload == version:
+                    if (isinstance(payload, (tuple, list)) and len(payload) == 2
+                            and payload[0] == boot and payload[1] == version):
                         cache_hits.inc()
                         reply(wire.encode_not_modified(version))
                     else:
                         reply(frames)
                 elif kind == "u":
                     buffer.apply_delta(payload)
+                    if wal_writer is not None:
+                        wal_writer.after_update()  # durable before the ack
+                    reply(b"ok")
+                elif kind == "h":  # heartbeat: payload = worker id
+                    detector.beat(str(payload))
+                    reply(b"ok")
+                elif kind == "m":  # membership table (sweeps first)
+                    reply(detector.membership())
+                elif kind == "d":  # deregister: payload = worker id
+                    detector.deregister(str(payload))
                     reply(b"ok")
                 elif kind == "b":  # barrier arrive(tag) -> count
                     reply(barriers.arrive(payload))
@@ -405,7 +549,52 @@ class _SocketHandler(socketserver.BaseRequestHandler):
             pass
 
 
-class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
+class _ConnectionTracker:
+    """socketserver mixin remembering live connections so a simulated crash
+    (``SocketServer.kill`` / ``HttpServer.kill``) can sever them.
+
+    ``shutdown()`` alone only stops the acceptor loop: persistent client
+    connections keep being served by their (daemon) handler threads, which
+    is NOT what a dead process looks like from the worker's side. Chaos
+    tests need the worker to actually observe broken pipes and
+    connection-refused, so ``sever_all`` force-closes every tracked
+    connection."""
+
+    def __init__(self, *args, **kwargs):
+        self._live_conns: set = set()
+        self._conns_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._live_conns.add(request)
+        super().process_request(request, client_address)
+
+    def close_request(self, request):
+        with self._conns_lock:
+            self._live_conns.discard(request)
+        super().close_request(request)
+
+    def sever_all(self) -> None:
+        with self._conns_lock:
+            conns = list(self._live_conns)
+            self._live_conns.clear()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class _TrackingHTTPServer(_ConnectionTracker, ThreadingHTTPServer):
+    daemon_threads = True
+
+
+class _ThreadingTCPServer(_ConnectionTracker, socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
@@ -423,11 +612,16 @@ class SocketServer(BaseParameterServer):
         host: Optional[str] = None,
         granularity: str = "tree",
         auth_key: Optional[bytes] = None,
+        wal_dir: Optional[str] = None,
+        wal_every: int = 1,
+        heartbeat_timeout: Optional[float] = None,
     ):
         """``auth_key``: shared HMAC-SHA256 secret — every frame in both
         directions carries a tag (nonce+timestamp under the MAC) verified
         before unpickling, and the server rejects replayed/stale nonces
-        (see ``utils.sockets.send/receive``/``ReplayGuard``)."""
+        (see ``utils.sockets.send/receive``/``ReplayGuard``).
+        ``wal_dir``/``wal_every``/``heartbeat_timeout``: see
+        ``HttpServer`` — identical durability and liveness semantics."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
@@ -435,16 +629,24 @@ class SocketServer(BaseParameterServer):
         self.auth_key = auth_key
         self.replay_guard = socket_utils.ReplayGuard() if auth_key else None
         self.barriers = _BarrierBook()
+        self.boot = _new_boot_id()
+        self.detector = _make_detector(heartbeat_timeout)
+        self.wal_writer = (
+            _attach_wal(self.buffer, wal_dir, wal_every) if wal_dir else None
+        )
         self._server = None
         self._thread = None
 
     def start(self) -> None:
         self._server = _ThreadingTCPServer((self.host, self.port), _SocketHandler)
         self._server.buffer = self.buffer  # type: ignore[attr-defined]
-        self._server.cache = _SnapshotCache(self.buffer)  # type: ignore[attr-defined]
+        self._server.cache = _SnapshotCache(self.buffer, boot=self.boot)  # type: ignore[attr-defined]
         self._server.barriers = self.barriers  # type: ignore[attr-defined]
         self._server.auth_key = self.auth_key  # type: ignore[attr-defined]
         self._server.replay_guard = self.replay_guard  # type: ignore[attr-defined]
+        self._server.boot = self.boot  # type: ignore[attr-defined]
+        self._server.detector = self.detector  # type: ignore[attr-defined]
+        self._server.wal_writer = self.wal_writer  # type: ignore[attr-defined]
         if self.port == 0:
             self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
@@ -453,6 +655,19 @@ class SocketServer(BaseParameterServer):
     def stop(self) -> None:
         if self._server is not None:
             self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self.wal_writer is not None:
+            self.wal_writer.sync()  # clean shutdown leaves zero WAL lag
+
+    def kill(self) -> None:
+        """Simulate a crash: sever live connections (persistent socket
+        clients would otherwise keep being served by their handler
+        threads) and skip the clean-shutdown WAL sync — durability after
+        a kill is exactly what ``after_update`` already flushed."""
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.sever_all()
             self._server.server_close()
             self._server = None
 
@@ -476,17 +691,35 @@ def make_server(
     host: Optional[str] = None,
     granularity: str = "tree",
     auth_key: Optional[bytes] = None,
+    wal_dir: Optional[str] = None,
+    wal_every: int = 1,
+    heartbeat_timeout: Optional[float] = None,
 ) -> BaseParameterServer:
     """Factory keyed on the reference's ``parameter_server_mode``.
     ``granularity`` ('tree'|'leaf') sets the hogwild apply isolation —
     see ``ParameterBuffer``'s memory-model note. ``auth_key`` turns on
-    HMAC wire authentication for the http/socket transports."""
+    HMAC wire authentication for the http/socket transports.
+    ``wal_dir``/``wal_every`` make accepted pushes durable and enable
+    warm restart (wire transports only — a local server shares the
+    workers' process, so any crash that needs the WAL also killed the
+    training job the WAL would resume into)."""
     if mode == "local":
-        return LocalServer(params, lock=lock, device=device, granularity=granularity)
+        if wal_dir is not None:
+            raise ValueError(
+                "wal_dir requires a wire transport (http|socket): the local "
+                "server dies with the training process it would be "
+                "restarted for"
+            )
+        return LocalServer(params, lock=lock, device=device, granularity=granularity,
+                           heartbeat_timeout=heartbeat_timeout)
     if mode == "http":
         return HttpServer(params, lock=lock, port=port, device=device, host=host,
-                          granularity=granularity, auth_key=auth_key)
+                          granularity=granularity, auth_key=auth_key,
+                          wal_dir=wal_dir, wal_every=wal_every,
+                          heartbeat_timeout=heartbeat_timeout)
     if mode == "socket":
         return SocketServer(params, lock=lock, port=port, device=device, host=host,
-                            granularity=granularity, auth_key=auth_key)
+                            granularity=granularity, auth_key=auth_key,
+                            wal_dir=wal_dir, wal_every=wal_every,
+                            heartbeat_timeout=heartbeat_timeout)
     raise ValueError(f"parameter_server_mode must be local|http|socket, got {mode!r}")

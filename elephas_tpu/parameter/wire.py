@@ -111,13 +111,21 @@ class NotModified:
 
 
 class DecodedTree:
-    """Decoded ``EPK1`` frame: ``tree`` (zero-copy leaves) + ``version``."""
+    """Decoded ``EPK1`` frame: ``tree`` (zero-copy leaves) + ``version``
+    (+ the serving server's ``boot`` id, when it sent one).
 
-    __slots__ = ("tree", "version")
+    ``boot`` (resilience layer): a parameter server mints a fresh random
+    boot id per process start, and version-gated pulls must match
+    (boot, version) — a server warm-restarted from a WAL snapshot resumes
+    an OLD version counter, so version alone could collide with a
+    client's cache and yield a stale not-modified."""
 
-    def __init__(self, tree, version: Optional[int]):
+    __slots__ = ("tree", "version", "boot")
+
+    def __init__(self, tree, version: Optional[int], boot: Optional[str] = None):
         self.tree = tree
         self.version = version
+        self.boot = boot
 
 
 def is_packed(buf) -> bool:
@@ -217,12 +225,16 @@ def _leaf_chunk(arr: np.ndarray):
 
 
 def encode_tree(tree, version: Optional[int] = None,
-                quantize: Optional[str] = None) -> Frames:
+                quantize: Optional[str] = None,
+                boot: Optional[str] = None) -> Frames:
     """Encode a pytree of arrays/scalars into a packed frame.
 
-    Raises ``WireFormatError`` for structures the skeleton can't carry
-    (non-JSON dict keys, custom container nodes) — callers fall back to
-    ``encode_pickle``.
+    ``boot``: the serving PS's boot id, carried in the header so clients
+    can key their pull cache on (boot, version) — omitted (and absent
+    from the JSON) when None, keeping frames byte-identical with
+    pre-resilience peers. Raises ``WireFormatError`` for structures the
+    skeleton can't carry (non-JSON dict keys, custom container nodes) —
+    callers fall back to ``encode_pickle``.
     """
     leaves: List[Any] = []
     skeleton = _build_skeleton(tree, leaves)
@@ -247,10 +259,11 @@ def encode_tree(tree, version: Optional[int] = None,
         payload_chunks.append(_leaf_chunk(arr))
         offset += arr.nbytes
 
-    header = json.dumps(
-        {"v": 1, "ver": version, "skel": skeleton, "leaves": rows},
-        separators=(",", ":"),
-    ).encode()
+    meta: Dict[str, Any] = {"v": 1, "ver": version, "skel": skeleton,
+                            "leaves": rows}
+    if boot is not None:
+        meta["boot"] = str(boot)
+    header = json.dumps(meta, separators=(",", ":")).encode()
     # Pad the header with spaces (JSON-transparent) so the payload
     # region starts 64B-aligned relative to the frame start.
     header += b" " * ((-(_PREFIX + len(header))) % _ALIGN)
@@ -341,7 +354,7 @@ def decode(buf, expect_treedef=None):
                 f"packed frame treedef mismatch: got {got}, expected "
                 f"{expect_treedef}"
             )
-    return DecodedTree(tree, header.get("ver"))
+    return DecodedTree(tree, header.get("ver"), header.get("boot"))
 
 
 def decode_payload(buf, expect_treedef=None):

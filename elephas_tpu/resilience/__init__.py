@@ -1,0 +1,43 @@
+"""Resilience layer: fault-tolerant, elastic async training.
+
+The reference elephas inherited ALL of its fault tolerance from Spark
+(task retry, executor replacement, driver-held state); the TPU rebuild
+dropped Spark and, until this package, owned none of it — a PS crash or
+a wedged worker killed the fit. Four pieces rebuild the story natively:
+
+- ``liveness``  — worker heartbeats as wire frames, a server-side
+  timeout+suspect ``FailureDetector``, and the membership table the
+  trainer polls.
+- ``wal``       — ``SnapshotWAL``: write-ahead version-tagged snapshots
+  of the ``ParameterBuffer`` in the packed wire format; a restarted PS
+  warm-restarts from the last durable version and clients reconcile
+  through the (boot, version)-gated pull.
+- ``elastic``   — ``UnitLedger`` + ``ElasticWorkerPool``: dead workers'
+  frequency units re-queued to survivors, late joiners admitted
+  mid-epoch, accounting exact under any interleaving.
+- ``faults``    — ``FaultPlan``/``FaultInjector``: seeded, step-indexed
+  drops/delays/duplicates of wire frames and kills/stalls of worker
+  threads, so chaos tests replay deterministically.
+
+Entry point for training: ``AsyncTrainer(..., elastic=True,
+fault_plan=..., ps_wal_dir=...)`` (``engine.async_engine``).
+"""
+
+from elephas_tpu.resilience.elastic import (  # noqa: F401
+    ElasticWorkerPool,
+    UnitLedger,
+)
+from elephas_tpu.resilience.faults import (  # noqa: F401
+    FaultInjector,
+    FaultPlan,
+    InjectedWorkerDeath,
+    install,
+)
+from elephas_tpu.resilience.liveness import (  # noqa: F401
+    ALIVE,
+    DEAD,
+    SUSPECT,
+    FailureDetector,
+    MembershipView,
+)
+from elephas_tpu.resilience.wal import SnapshotWAL, WalWriter  # noqa: F401

@@ -1,0 +1,221 @@
+"""Chaos bench: measured recovery behavior of the resilience layer.
+
+Runs a small elastic async fit (socket PS transport, WAL-backed) under
+three fault scenarios plus an undisturbed baseline, and emits one JSON
+object per scenario so the numbers land as a committed artifact
+(``--out BENCH_CHAOS.json``):
+
+- ``{"scenario": "baseline"}`` — undisturbed elastic fit; its
+  ``final_loss`` is the tolerance anchor for every chaos arm (same data,
+  same seeds, unit-keyed determinism).
+- ``{"scenario": "kill_ps"}`` — the parameter server is crashed
+  (``SocketServer.kill``: acceptor down, live connections severed, NO
+  clean WAL sync) once a few updates are durable, held down for
+  ``--outage`` seconds, then warm-restarted on the same port from the
+  same WAL dir. Reports worker-observed MTTR samples (outage start →
+  first successful reconnect), units re-queued, and the durable version
+  the restart resumed from.
+- ``{"scenario": "kill_worker"}`` — a ``FaultPlan`` kills one worker
+  thread at its second leased unit; the monitor re-queues its pending
+  unit to survivors. Reports the re-queue count and the exact
+  frequency-unit accounting.
+- ``{"scenario": "partition"}`` — a deterministic partition window
+  drops every wire frame with ``start <= seq < end``; clients ride
+  their retry machinery through it. Reports retry-visible effects and
+  the plan's ``trace_digest`` (replays from the same seed match it).
+
+MTTR here is end-to-end as a WORKER experiences it: from the first
+failed round trip to the first successful one after recovery — it
+includes the bench's own outage hold-down, the client retry backoff,
+and reconnect cost, which is the number an operator actually sees.
+
+Importable without a TPU; tier-1-sized defaults finish in ~1 min on
+CPU. Usage:
+    python scripts/chaos_bench.py [--epochs 4] [--outage 4.0]
+        [--n 256] [--out BENCH_CHAOS.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_blobs(n: int, dim: int = 8, classes: int = 3, seed: int = 3):
+    """Gaussian class blobs + one-hot labels (mirrors the test fixture —
+    re-implemented here so the bench doesn't import from tests/)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((classes, dim)) * 3.0
+    labels = rng.integers(0, classes, size=n)
+    x = centers[labels] + rng.standard_normal((n, dim))
+    y = np.eye(classes, dtype=np.float32)[labels]
+    return x.astype(np.float32), y
+
+
+def _build_net():
+    from elephas_tpu import compile_model
+    from elephas_tpu.models import get_model
+
+    return compile_model(
+        get_model("mlp", features=(16,), num_classes=3),
+        optimizer={"name": "sgd", "learning_rate": 0.05},
+        loss="categorical_crossentropy", metrics=["acc"],
+        input_shape=(8,), seed=0,
+    )
+
+
+def _build_trainer(fault_plan=None, wal_dir=None, grace: float = 30.0):
+    from elephas_tpu.engine.async_engine import AsyncTrainer
+    from elephas_tpu.parallel.mesh import build_mesh
+
+    net = _build_net()
+    return AsyncTrainer(
+        net, build_mesh(num_data=2), frequency="epoch",
+        parameter_server_mode="socket", port=0, elastic=True,
+        fault_plan=fault_plan, ps_wal_dir=wal_dir, ps_recovery_grace=grace,
+    )
+
+
+def _run_fit(trainer, x, y, epochs: int, chaos=None):
+    """Fit on a worker thread (chaos needs the main thread free to kill
+    things); returns (history, stats, wall_seconds, chaos_detail)."""
+    from elephas_tpu.data.rdd import ShardedDataset
+
+    result, detail = {}, {}
+
+    def run():
+        result["out"] = trainer.fit(ShardedDataset(x, y, 2),
+                                    epochs=epochs, batch_size=16)
+
+    t0 = time.perf_counter()
+    th = threading.Thread(target=run)
+    th.start()
+    if chaos is not None:
+        detail = chaos(trainer)
+    th.join()
+    wall = time.perf_counter() - t0
+    _, history = result["out"]
+    return history, trainer.elastic_stats, wall, detail
+
+
+def _stats_row(scenario, history, stats, wall, **extra):
+    mttr = stats["mttr_samples"]
+    return {
+        "scenario": scenario,
+        "wall_s": round(wall, 2),
+        "final_loss": round(float(history["loss"][-1]), 5),
+        "completed_units": stats["completed_units"],
+        "requeued_units": stats["requeued_units"],
+        "worker_deaths": len(stats["worker_deaths"]),
+        "ps_outages": len(stats["ps_outages"]),
+        "mttr_mean_s": round(sum(mttr) / len(mttr), 3) if mttr else None,
+        "mttr_max_s": round(max(mttr), 3) if mttr else None,
+        **extra,
+    }
+
+
+def scenario_baseline(x, y, epochs):
+    history, stats, wall, _ = _run_fit(_build_trainer(), x, y, epochs)
+    return _stats_row("baseline", history, stats, wall)
+
+
+def scenario_kill_ps(x, y, epochs, outage: float):
+    from elephas_tpu.parameter.server import make_server
+
+    def chaos(trainer):
+        while trainer._elastic_server is None:
+            time.sleep(0.005)
+        server = trainer._elastic_server
+        port, wal_dir = server.port, trainer.ps_wal_dir
+        while server.buffer.version < 3:  # let some updates become durable
+            time.sleep(0.005)
+        server.kill()
+        killed_at = server.buffer.version
+        time.sleep(outage)  # outage > client retry budget → real failures
+        # Warm restart on the same port: a COLD initial store (as a real
+        # supervisor restart would have), immediately superseded by the
+        # WAL's newest durable snapshot during construction.
+        cold = _build_net()
+        fresh = make_server(
+            "socket",
+            {"params": cold.params, "batch_stats": cold.batch_stats},
+            port=port, wal_dir=wal_dir,
+        )
+        fresh.start()
+        trainer._elastic_server = fresh
+        return {"durable_version_at_kill": killed_at,
+                "resumed_version": fresh.buffer.version,
+                "outage_hold_s": outage}
+
+    with tempfile.TemporaryDirectory() as wal_dir:
+        trainer = _build_trainer(wal_dir=wal_dir, grace=max(30.0, 4 * outage))
+        history, stats, wall, detail = _run_fit(trainer, x, y, epochs,
+                                                chaos=chaos)
+    return _stats_row("kill_ps", history, stats, wall, **detail)
+
+
+def scenario_kill_worker(x, y, epochs):
+    from elephas_tpu.resilience import FaultPlan
+
+    plan = FaultPlan(seed=11, kill_worker_at={"w1": 1})
+    trainer = _build_trainer(fault_plan=plan)
+    history, stats, wall, _ = _run_fit(trainer, x, y, epochs)
+    return _stats_row("kill_worker", history, stats, wall,
+                      trace_digest=hex(plan.trace_digest()))
+
+
+def scenario_partition(x, y, epochs):
+    from elephas_tpu.resilience import FaultPlan
+
+    # Frames 6..14 (per peer, send side) hit the void: mid-fit both
+    # workers lose a handful of round trips and retry through them.
+    plan = FaultPlan(seed=23, partition={"*": (6, 14)})
+    trainer = _build_trainer(fault_plan=plan)
+    history, stats, wall, _ = _run_fit(trainer, x, y, epochs)
+    return _stats_row("partition", history, stats, wall,
+                      trace_digest=hex(plan.trace_digest()))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--n", type=int, default=256)
+    ap.add_argument("--outage", type=float, default=4.0,
+                    help="kill_ps hold-down seconds (keep above the "
+                         "~2.8s client retry budget so failures surface)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    x, y = make_blobs(args.n)
+    rows = [{"scenario": "meta", "epochs": args.epochs, "n": args.n,
+             "partitions": 2, "workers": 2, "transport": "socket",
+             "expected_units": args.epochs * 2}]
+    rows.append(scenario_baseline(x, y, args.epochs))
+    rows.append(scenario_kill_ps(x, y, args.epochs, args.outage))
+    rows.append(scenario_kill_worker(x, y, args.epochs))
+    rows.append(scenario_partition(x, y, args.epochs))
+
+    anchor = rows[1]["final_loss"]
+    for row in rows[2:]:
+        row["loss_vs_baseline"] = round(row["final_loss"] - anchor, 5)
+
+    for row in rows:
+        print(json.dumps(row))
+    if args.out:
+        with open(args.out, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,18 @@ full-copy serialization the codec exists to remove — and worse, a
 verify-before-decode hole. Flagged outside ``wire.py``; the escape
 pragma is ``# pickle-ok``.
 
+A fourth rule guards the RESILIENCE CLOCK DOMAIN
+(``elephas_tpu/resilience/``): failure detection, MTTR measurement, and
+fault injection are all specified against injectable ``clock=`` /
+``sleep=`` hooks so chaos tests replay deterministically on fake time
+with zero real waiting. A raw ``time.time()`` / ``time.monotonic()`` /
+``time.perf_counter()`` — or, new in this domain, a raw ``time.sleep()``
+— hard-wires wall time into a code path tests need to drive, so all four
+are flagged anywhere in the resilience package. ``time.monotonic`` /
+``time.sleep`` as default-argument VALUES are fine (that IS the
+injection pattern); only calls are flagged. Escape pragma:
+``# clock-ok``, for timing provably outside any detector/injector path.
+
 Wired into tier-1 via ``tests/test_lint_blocking.py``; also runnable
 standalone: ``python scripts/lint_blocking.py`` (exit 1 on violations).
 """
@@ -58,6 +70,7 @@ PRAGMA = "host-ok"
 SANCTIONED = "host_sync.py"
 PICKLE_PRAGMA = "pickle-ok"
 PICKLE_SANCTIONED = "wire.py"
+CLOCK_PRAGMA = "clock-ok"
 _NUMPY_NAMES = ("np", "numpy")
 _CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
 _PICKLE_ATTRS = ("dumps", "loads", "dump", "load")
@@ -68,8 +81,19 @@ class Violation(NamedTuple):
     lineno: int
     call: str
     line: str
+    domain: str = "serving"
 
     def __str__(self):
+        if self.domain == "resilience":
+            what = "raw sleep" if self.call == "time.sleep" \
+                else "raw clock call"
+            return (
+                f"{self.path}:{self.lineno}: {what} `{self.call}` in "
+                f"resilience code bypasses the injected clock/sleep hooks "
+                f"(thread a `clock=`/`sleep=` parameter so chaos tests run "
+                f"on fake time; `# {CLOCK_PRAGMA}` only for timing outside "
+                f"every detector/injector path)\n    {self.line.strip()}"
+            )
         if self.call.startswith("pickle."):
             return (
                 f"{self.path}:{self.lineno}: direct `{self.call}` outside "
@@ -187,6 +211,45 @@ def lint_pickle_package(root: Path) -> List[Violation]:
     return out
 
 
+def _resilience_call_name(node: ast.Call) -> str | None:
+    """``time.<clock>()`` AND ``time.sleep()`` — the resilience domain
+    bans both (everything there takes ``clock=``/``sleep=`` hooks)."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "time" \
+            and fn.attr in _CLOCK_ATTRS + ("sleep",):
+        return f"time.{fn.attr}"
+    return None
+
+
+def lint_resilience_file(path: Path) -> List[Violation]:
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _resilience_call_name(node)
+        if name is None:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if CLOCK_PRAGMA in line:
+            continue
+        out.append(Violation(str(path), node.lineno, name, line,
+                             domain="resilience"))
+    return out
+
+
+def lint_resilience_package(root: Path) -> List[Violation]:
+    """Lint every module in the resilience package — no sanctioned file:
+    real wall time enters ONLY through default-argument values."""
+    out = []
+    for path in sorted(root.glob("*.py")):
+        out.extend(lint_resilience_file(path))
+    return out
+
+
 def main(argv: List[str] | None = None) -> List[Violation]:
     args = list(sys.argv[1:] if argv is None else argv)
     pkg_root = Path(__file__).resolve().parent.parent / "elephas_tpu"
@@ -194,6 +257,7 @@ def main(argv: List[str] | None = None) -> List[Violation]:
     violations = lint_package(root)
     if not args:
         violations.extend(lint_pickle_package(pkg_root / "parameter"))
+        violations.extend(lint_resilience_package(pkg_root / "resilience"))
     for v in violations:
         print(v)
     if not violations:

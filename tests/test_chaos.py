@@ -1,0 +1,176 @@
+"""Chaos integration: the ISSUE's acceptance scenarios, end to end.
+
+- kill the parameter server mid-fit → warm restart from the WAL on the
+  same port → the fit completes and the final loss is within tolerance
+  of an undisturbed run;
+- kill one worker → its pending units are re-queued to survivors and
+  the total frequency-unit count stays exact;
+- both replay deterministically from the same ``FaultPlan`` seed
+  (``trace_digest`` pins the consulted fault sites).
+
+These use real sockets, real threads, and (for the PS scenario) a real
+crash — ``SocketServer.kill`` severs live connections and skips the
+clean-shutdown WAL sync — so they cost a few real seconds each; the
+fake-clock unit coverage lives in ``test_resilience.py``.
+"""
+
+import tempfile
+import threading
+import time
+
+import pytest
+
+from elephas_tpu import compile_model
+from elephas_tpu.data.rdd import ShardedDataset
+from elephas_tpu.engine.async_engine import AsyncTrainer
+from elephas_tpu.models import get_model
+from elephas_tpu.parallel.mesh import build_mesh
+from elephas_tpu.parameter.server import make_server
+from elephas_tpu.resilience import FaultPlan
+
+from conftest import make_blobs
+
+EPOCHS = 3
+PARTITIONS = 2
+UNITS = EPOCHS * PARTITIONS
+
+
+def _net():
+    return compile_model(
+        get_model("mlp", features=(16,), num_classes=3),
+        optimizer={"name": "sgd", "learning_rate": 0.05},
+        loss="categorical_crossentropy", metrics=["acc"],
+        input_shape=(8,), seed=0,
+    )
+
+
+def _trainer(**kw):
+    return AsyncTrainer(_net(), build_mesh(num_data=PARTITIONS),
+                        frequency="epoch", parameter_server_mode="socket",
+                        port=0, elastic=True, **kw)
+
+
+@pytest.fixture(scope="module")
+def blobs_xy():
+    return make_blobs(n=256, num_classes=3, dim=8, seed=3)
+
+
+@pytest.fixture(scope="module")
+def baseline_loss(blobs_xy):
+    """Undisturbed elastic fit: the tolerance anchor for every chaos
+    arm (same data, same seeds — unit-keyed determinism)."""
+    x, y = blobs_xy
+    trainer = _trainer()
+    _, history = trainer.fit(ShardedDataset(x, y, PARTITIONS),
+                             epochs=EPOCHS, batch_size=16)
+    assert trainer.elastic_stats["completed_units"] == UNITS
+    assert history["loss"][-1] < history["loss"][0]
+    return float(history["loss"][-1])
+
+
+def test_elastic_requires_epoch_frequency():
+    with pytest.raises(ValueError, match="epoch"):
+        AsyncTrainer(_net(), build_mesh(num_data=PARTITIONS),
+                     frequency="batch", elastic=True)
+
+
+def test_kill_worker_exact_accounting_and_tolerant_loss(
+        blobs_xy, baseline_loss):
+    x, y = blobs_xy
+    plan = FaultPlan(seed=11, kill_worker_at={"w1": 1})
+    trainer = _trainer(fault_plan=plan)
+    _, history = trainer.fit(ShardedDataset(x, y, PARTITIONS),
+                             epochs=EPOCHS, batch_size=16)
+    stats = trainer.elastic_stats
+    assert stats["completed_units"] == UNITS  # exact despite the death
+    assert stats["requeued_units"] >= 1
+    deaths = stats["worker_deaths"]
+    assert [d["worker"] for d in deaths] == ["w1"]
+    assert deaths[0]["reason"] == "injected kill"
+    assert len(history["loss"]) == EPOCHS
+    assert abs(history["loss"][-1] - baseline_loss) < 0.02
+
+
+def test_kill_worker_replays_byte_identically(blobs_xy):
+    """Two fits from the same FaultPlan seed consult the same fault
+    sites: the order-independent trace digest matches exactly."""
+    x, y = blobs_xy
+    digests = []
+    for _ in range(2):
+        plan = FaultPlan(seed=11, kill_worker_at={"w1": 1})
+        trainer = _trainer(fault_plan=plan)
+        trainer.fit(ShardedDataset(x, y, PARTITIONS),
+                    epochs=EPOCHS, batch_size=16)
+        assert trainer.elastic_stats["completed_units"] == UNITS
+        digests.append(plan.trace_digest())
+    assert digests[0] == digests[1]
+
+
+def test_kill_ps_warm_restart_completes_within_tolerance(
+        blobs_xy, baseline_loss):
+    """Crash the PS once a few pushes are durable, hold it down past
+    the client retry budget (~2.8s), warm-restart on the same port from
+    the same WAL dir: the fit rides it out, resumes from the durable
+    version, and lands within tolerance of the undisturbed loss."""
+    x, y = blobs_xy
+    with tempfile.TemporaryDirectory() as wal_dir:
+        trainer = _trainer(ps_wal_dir=wal_dir, ps_recovery_grace=30.0)
+        result = {}
+
+        def run():
+            result["out"] = trainer.fit(ShardedDataset(x, y, PARTITIONS),
+                                        epochs=EPOCHS, batch_size=16)
+
+        fit_thread = threading.Thread(target=run)
+        fit_thread.start()
+        try:
+            deadline = time.monotonic() + 30.0
+            while trainer._elastic_server is None:
+                assert time.monotonic() < deadline, "server never came up"
+                time.sleep(0.005)
+            server = trainer._elastic_server
+            port = server.port
+            while server.buffer.version < 2:  # some updates are durable
+                assert fit_thread.is_alive(), "fit died before the kill"
+                time.sleep(0.005)
+            server.kill()
+            killed_at = server.buffer.version
+            time.sleep(4.0)  # outage > retry budget: failures surface
+            cold = _net()  # a supervisor restart boots from cold init...
+            fresh = make_server(
+                "socket",
+                {"params": cold.params, "batch_stats": cold.batch_stats},
+                port=port, wal_dir=wal_dir,
+            )
+            fresh.start()  # ...and the WAL supersedes it at construction
+            trainer._elastic_server = fresh
+            assert fresh.buffer.version >= killed_at  # nothing acked lost
+        finally:
+            fit_thread.join(timeout=120)
+        assert not fit_thread.is_alive(), "fit hung after the restart"
+        _, history = result["out"]
+
+    stats = trainer.elastic_stats
+    assert stats["completed_units"] == UNITS
+    assert stats["ps_outages"], "no worker observed the outage"
+    assert all(o["recovered"] for o in stats["ps_outages"])
+    assert stats["mttr_samples"], "MTTR was not measured"
+    assert len(history["loss"]) == EPOCHS
+    assert abs(history["loss"][-1] - baseline_loss) < 0.02
+
+
+def test_partition_window_is_ridden_out(blobs_xy, baseline_loss):
+    """A deterministic partition (frames 6..13 per peer vanish) pushes
+    some round trips past their retry budget; the pool re-queues and
+    completes with exact accounting, and the plan digest is stable."""
+    x, y = blobs_xy
+    digests = []
+    for _ in range(2):
+        plan = FaultPlan(seed=23, partition={"*": (6, 14)})
+        trainer = _trainer(fault_plan=plan)
+        _, history = trainer.fit(ShardedDataset(x, y, PARTITIONS),
+                                 epochs=EPOCHS, batch_size=16)
+        assert trainer.elastic_stats["completed_units"] == UNITS
+        assert abs(history["loss"][-1] - baseline_loss) < 0.02
+        digests.append(plan.trace_digest())
+    assert digests[0] == digests[1]

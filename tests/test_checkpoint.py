@@ -46,6 +46,42 @@ def test_restore_missing_raises(tmp_path):
         restore_train_state(str(tmp_path / "empty"), target)
 
 
+def test_restore_missing_raises_typed_error(tmp_path):
+    """Cold start is a TYPED condition: callers branch on
+    ``NoCheckpointError`` (initialize fresh state) without catching
+    unrelated FileNotFoundErrors, and the message says what to do."""
+    from elephas_tpu.checkpoint import NoCheckpointError
+
+    target = init_train_state(_compiled())
+    with pytest.raises(NoCheckpointError, match="cold start"):
+        restore_train_state(str(tmp_path / "missing"), target)
+    (tmp_path / "empty").mkdir()  # exists but holds no snapshots
+    with pytest.raises(NoCheckpointError):
+        restore_train_state(str(tmp_path / "empty"), target)
+    mgr = CheckpointManager(str(tmp_path / "empty"), keep=2)
+    with pytest.raises(NoCheckpointError):
+        mgr.restore(target)
+    mgr.close()
+
+
+def test_module_level_latest_step(tmp_path):
+    """``latest_step(dir)`` answers "where would a restart resume?"
+    WITHOUT constructing a manager: None on missing/empty/junk-only
+    dirs, the max step once snapshots exist."""
+    from elephas_tpu.checkpoint import latest_step
+
+    assert latest_step(str(tmp_path / "missing")) is None
+    assert latest_step(str(tmp_path)) is None
+    (tmp_path / "not-a-step").mkdir()
+    assert latest_step(str(tmp_path)) is None
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    state = init_train_state(_compiled())
+    for step in (2, 5):
+        mgr.save(state, step=step)
+    mgr.close()
+    assert latest_step(str(tmp_path)) == 5
+
+
 def test_manager_rotation_and_latest(tmp_path):
     mgr = CheckpointManager(str(tmp_path), keep=2)
     compiled = _compiled()

@@ -174,6 +174,56 @@ def test_pickle_sanctioned_module_is_wire(tmp_path):
     assert violations[0].path.endswith("client.py")
 
 
+def test_resilience_package_uses_injected_clocks():
+    """THE resilience invariant: failure detection, MTTR measurement,
+    and fault injection all run on injectable ``clock=``/``sleep=``
+    hooks — a raw ``time.*()`` call (INCLUDING ``time.sleep``) anywhere
+    in elephas_tpu/resilience/ hard-wires wall time into a path chaos
+    tests need to drive, and fails tier-1 here."""
+    root = Path(lint.__file__).resolve().parent.parent / \
+        "elephas_tpu" / "resilience"
+    assert root.is_dir()
+    violations = lint.lint_resilience_package(root)
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_resilience_lint_catches_sleep_and_clocks(tmp_path):
+    """Unlike the serving rule, the resilience domain also bans
+    ``time.sleep`` calls (everything there threads a ``sleep=`` hook)."""
+    bad = tmp_path / "waity.py"
+    bad.write_text(textwrap.dedent("""
+        import time
+
+        def f(self):
+            time.sleep(0.1)
+            a = time.monotonic()
+            b = time.time()
+            c = time.perf_counter()
+            return a, b, c
+    """))
+    calls = {v.call for v in lint.lint_resilience_file(bad)}
+    assert calls == {
+        "time.sleep", "time.monotonic", "time.time", "time.perf_counter",
+    }
+    by_call = {v.call: str(v) for v in lint.lint_resilience_file(bad)}
+    assert "raw sleep" in by_call["time.sleep"]
+    assert "injected clock/sleep" in by_call["time.monotonic"]
+
+
+def test_resilience_lint_allows_default_values_and_pragma(tmp_path):
+    """``sleep=time.sleep`` / ``clock=time.monotonic`` default VALUES are
+    the injection idiom itself; the escape pragma is ``# clock-ok``."""
+    ok = tmp_path / "hooks.py"
+    ok.write_text(textwrap.dedent("""
+        import time
+
+        def make(clock=time.monotonic, sleep=time.sleep):
+            stamp = time.time()  # clock-ok: one-shot artifact timestamp
+            return clock, sleep, stamp
+    """))
+    assert lint.lint_resilience_file(ok) == []
+
+
 def test_cli_reports_clean(capsys):
     assert lint.main([]) == []
     assert "clean" in capsys.readouterr().out

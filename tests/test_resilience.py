@@ -1,0 +1,639 @@
+"""Resilience layer units: failure detector, WAL, fault plans, ledger,
+elastic pool, and the injected-sleep retry schedules.
+
+Everything here runs on FAKE clocks/sleeps (no real waiting beyond
+thread joins) — the lint (`test_lint_blocking.py`) enforces that the
+production modules expose the hooks these tests drive.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from elephas_tpu.checkpoint import NoCheckpointError
+from elephas_tpu.parameter.client import (
+    ParameterServerUnavailable,
+    _RETRY_DELAYS,
+    _retry_connect,
+)
+from elephas_tpu.resilience import (
+    ALIVE,
+    DEAD,
+    SUSPECT,
+    ElasticWorkerPool,
+    FailureDetector,
+    FaultInjector,
+    FaultPlan,
+    MembershipView,
+    SnapshotWAL,
+    UnitLedger,
+    WalWriter,
+)
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+class SleepRecorder:
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, seconds):
+        self.calls.append(seconds)
+
+
+# --------------------------------------------------------------------------
+# FailureDetector / MembershipView
+# --------------------------------------------------------------------------
+
+
+def _detector(clock, suspect_after=5.0, **kw):
+    return FailureDetector(suspect_after=suspect_after, clock=clock,
+                           register_metrics=False, **kw)
+
+
+def test_detector_state_transitions_on_fake_clock():
+    clock = FakeClock()
+    det = _detector(clock)
+    det.beat("w0")
+    assert det.state("w0") == ALIVE
+    clock.advance(5.0)  # age == suspect_after
+    assert det.state("w0") == SUSPECT
+    clock.advance(5.0)  # age == dead_after (2x default)
+    assert det.state("w0") == DEAD
+    det.beat("w0")  # revival: a beat from a dead worker rejoins
+    assert det.state("w0") == ALIVE
+
+
+def test_detector_sweep_is_edge_triggered():
+    clock = FakeClock()
+    det = _detector(clock)
+    det.beat("w0")
+    det.beat("w1")
+    clock.advance(100.0)
+    assert sorted(det.sweep()) == ["w0", "w1"]
+    assert det.sweep() == []  # reported exactly once
+    det.beat("w0")
+    clock.advance(100.0)
+    assert det.sweep() == ["w0"]  # re-dies after revival → reported again
+
+
+def test_detector_deregister_is_not_an_expiry():
+    clock = FakeClock()
+    det = _detector(clock)
+    det.beat("w0")
+    det.deregister("w0")
+    clock.advance(100.0)
+    assert det.sweep() == []
+    assert det.membership() == {}
+
+
+def test_detector_membership_table_shape():
+    clock = FakeClock()
+    det = _detector(clock)
+    det.beat("w0")
+    det.beat("w0")
+    clock.advance(1.5)
+    table = det.membership()
+    assert table["w0"]["state"] == ALIVE
+    assert table["w0"]["age_s"] == pytest.approx(1.5)
+    assert table["w0"]["beats"] == 2
+
+
+def test_detector_expiry_counter_bumps():
+    from elephas_tpu import obs
+
+    counter = obs.default_registry().counter("ps_worker_expired_total")
+    before = counter.value
+    clock = FakeClock()
+    det = FailureDetector(suspect_after=1.0, clock=clock)
+    det.beat("w0")
+    clock.advance(10.0)
+    det.membership()  # reading the table IS the evaluation point
+    assert counter.value == before + 1
+
+
+def test_detector_validates_thresholds():
+    with pytest.raises(ValueError):
+        FailureDetector(suspect_after=0.0, register_metrics=False)
+    with pytest.raises(ValueError):
+        FailureDetector(suspect_after=5.0, dead_after=1.0,
+                        register_metrics=False)
+
+
+def test_membership_view_fencing_reads():
+    view = MembershipView()
+    assert view.state("w0") is None and not view.is_dead("w0")
+    view.publish({"w0": {"state": DEAD}, "w1": {"state": ALIVE}})
+    assert view.is_dead("w0") and not view.is_dead("w1")
+    assert view.snapshot()["w1"]["state"] == ALIVE
+
+
+# --------------------------------------------------------------------------
+# SnapshotWAL / WalWriter
+# --------------------------------------------------------------------------
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"dense": {"kernel": rng.standard_normal((4, 3)).astype(np.float32),
+                      "bias": np.zeros(3, np.float32)}}
+
+
+def test_wal_roundtrip_and_latest(tmp_path):
+    wal = SnapshotWAL(str(tmp_path))
+    with pytest.raises(NoCheckpointError):
+        wal.restore_latest()  # cold start is typed
+    wal.append(_tree(1), version=1)
+    wal.append(_tree(2), version=2)
+    assert wal.latest_version() == 2
+    version, tree = wal.restore_latest()
+    assert version == 2
+    np.testing.assert_array_equal(tree["dense"]["kernel"],
+                                  _tree(2)["dense"]["kernel"])
+
+
+def test_wal_rotation_bounds_disk(tmp_path):
+    wal = SnapshotWAL(str(tmp_path), keep=2)
+    for v in (1, 2, 3, 4):
+        wal.append(_tree(v), version=v)
+    assert wal.versions() == [3, 4]
+
+
+def test_wal_restore_walks_past_corrupt_tail(tmp_path):
+    wal = SnapshotWAL(str(tmp_path))
+    wal.append(_tree(1), version=1)
+    path2 = wal.append(_tree(2), version=2)
+    path2.write_bytes(path2.read_bytes()[: 40])  # torn copy of the newest
+    version, tree = wal.restore_latest()
+    assert version == 1
+    np.testing.assert_array_equal(tree["dense"]["bias"],
+                                  _tree(1)["dense"]["bias"])
+
+
+def test_wal_append_is_idempotent_per_version(tmp_path):
+    wal = SnapshotWAL(str(tmp_path))
+    wal.append(_tree(1), version=5)
+    wal.append(_tree(2), version=5)  # second writer loses, silently
+    _, tree = wal.restore_latest()
+    np.testing.assert_array_equal(tree["dense"]["kernel"],
+                                  _tree(1)["dense"]["kernel"])
+
+
+class _FakeBuffer:
+    """version + get_numpy_with_version — the WalWriter's whole view."""
+
+    def __init__(self):
+        self.version = 0
+        self.tree = _tree()
+
+    def get_numpy_with_version(self):
+        return self.version, self.tree
+
+
+def test_wal_writer_cadence(tmp_path):
+    buf = _FakeBuffer()
+    writer = WalWriter(buf, SnapshotWAL(str(tmp_path)), every=2)
+    buf.version = 1
+    assert not writer.after_update()  # 1 version ahead < every
+    buf.version = 2
+    assert writer.after_update()
+    assert writer.last_written == 2
+    buf.version = 3
+    assert not writer.after_update()
+    assert writer.sync() == 3  # shutdown hook forces the tail out
+    assert writer.last_written == 3
+
+
+def test_wal_writer_resumes_cadence_from_durable_version(tmp_path):
+    wal = SnapshotWAL(str(tmp_path))
+    wal.append(_tree(), version=6)
+    buf = _FakeBuffer()
+    buf.version = 6
+    writer = WalWriter(buf, wal, every=3)
+    assert writer.last_written == 6  # warm restart: no re-snapshot at 6
+    buf.version = 8
+    assert not writer.after_update()
+    buf.version = 9
+    assert writer.after_update()
+
+
+# --------------------------------------------------------------------------
+# FaultPlan / FaultInjector
+# --------------------------------------------------------------------------
+
+
+def test_fault_plan_is_pure_in_seed_and_site():
+    a = FaultPlan(seed=5, drop=0.5, delay=0.5, duplicate=0.5)
+    b = FaultPlan(seed=5, drop=0.5, delay=0.5, duplicate=0.5)
+    sites = [("send", "w0", s) for s in range(40)]
+    assert [a.frame_action(*s) for s in sites] == \
+        [b.frame_action(*s) for s in sites]
+    assert a.trace_digest() == b.trace_digest()
+    # consulting the same sites in a different order agrees too
+    c = FaultPlan(seed=5, drop=0.5, delay=0.5, duplicate=0.5)
+    for s in reversed(sites):
+        c.frame_action(*s)
+    assert c.trace_digest() == a.trace_digest()
+
+
+def test_fault_plan_partition_window():
+    plan = FaultPlan(seed=0, partition={"*": (2, 4)})
+    actions = [plan.frame_action("send", "w0", s)[0] for s in range(6)]
+    assert actions == ["pass", "pass", "drop", "drop", "pass", "pass"]
+    labelled = FaultPlan(seed=0, partition={"w1": (0, 2)})
+    assert labelled.frame_action("send", "w0", 0)[0] == "pass"
+    assert labelled.frame_action("send", "w1", 0)[0] == "drop"
+
+
+def test_fault_plan_worker_sites():
+    plan = FaultPlan(seed=0, kill_worker_at={"w0": 2},
+                     stall_worker_at={"w1": (0, 3)}, stall_seconds=7.5)
+    assert not plan.should_kill("w0", 1)
+    assert plan.should_kill("w0", 2)
+    assert plan.stall_for("w1", 0) == 7.5
+    assert plan.stall_for("w1", 1) == 0.0
+    assert plan.stall_for("w1", 3) == 7.5
+
+
+def test_fault_injector_drop_dup_delay_and_seq():
+    sleeps = SleepRecorder()
+    plan = FaultPlan(seed=0, partition={"w0": (0, 1)}, delay={"w0": 1.0},
+                     duplicate={"w0": 1.0}, delay_seconds=0.25)
+    injector = FaultInjector(plan, sleep=sleeps)
+    sock = object()
+    injector.label_socket(sock, "w0")
+    with pytest.raises(ConnectionError):
+        injector.on_send(sock)  # seq 0 sits in the partition window
+    assert injector.on_send(sock) == "dup"  # seq 1: duplicate + delay
+    assert sleeps.calls == [0.25]  # delay rode the injected sleep
+
+
+def test_fault_injector_unlabeled_sockets_share_anonymous_label():
+    plan = FaultPlan(seed=0, partition={"?": (0, 10)})
+    injector = FaultInjector(plan)
+    with pytest.raises(ConnectionError):
+        injector.on_recv(object())
+    # labels have independent seq streams: w0's seq 0 is its own site
+    labelled = object()
+    injector.label_socket(labelled, "w0")
+    assert injector.on_send(labelled) == "pass"
+
+
+def test_fault_injector_maybe_fail_worker():
+    sleeps = SleepRecorder()
+    plan = FaultPlan(seed=0, kill_worker_at={"w0": 1},
+                     stall_worker_at={"w0": 0}, stall_seconds=3.0)
+    injector = FaultInjector(plan, sleep=sleeps)
+    injector.maybe_fail_worker("w0", 0)  # stall only
+    assert sleeps.calls == [3.0]
+    from elephas_tpu.resilience import InjectedWorkerDeath
+
+    with pytest.raises(InjectedWorkerDeath):
+        injector.maybe_fail_worker("w0", 1)
+
+
+# --------------------------------------------------------------------------
+# UnitLedger
+# --------------------------------------------------------------------------
+
+
+def test_ledger_leases_epoch_major():
+    ledger = UnitLedger(2, [0, 1])
+    order = [ledger.lease("w") for _ in range(4)]
+    assert order == [(0, 0), (0, 1), (1, 0), (1, 1)]
+    assert ledger.lease("w") is None
+
+
+def test_ledger_requeue_goes_to_front_in_epoch_order():
+    ledger = UnitLedger(2, [0, 1])
+    assert ledger.lease("dead") == (0, 0)
+    assert ledger.lease("dead") == (0, 1)
+    assert ledger.requeue_worker("dead") == [(0, 0), (0, 1)]
+    assert ledger.lease("survivor") == (0, 0)  # earliest hole first
+    assert ledger.requeue_worker("dead") == []  # idempotent
+
+
+def test_ledger_completion_accounting_is_exact():
+    ledger = UnitLedger(1, [0, 1])
+    u0, u1 = ledger.lease("w0"), ledger.lease("w1")
+    counted, finished = ledger.complete("w0", u0)
+    assert counted and finished is None
+    counted, finished = ledger.complete("w1", u1)
+    assert counted and finished == 0  # last partition closes the epoch
+    assert ledger.complete("w0", u0) == (False, None)  # duplicate
+    assert ledger.completed_units == 2
+    assert ledger.all_done()
+
+
+def test_ledger_zombie_duplicate_removes_requeued_copy():
+    """A stalled worker's lease is re-queued; the zombie then finishes
+    its copy. The completion counts ONCE and the pending duplicate is
+    dropped so no survivor re-runs counted work."""
+    ledger = UnitLedger(1, [0])
+    unit = ledger.lease("zombie")
+    ledger.requeue_worker("zombie")  # detector expired the stall
+    counted, finished = ledger.complete("zombie", unit)  # zombie wakes
+    assert counted and finished == 0
+    assert ledger.lease("survivor") is None  # duplicate copy is gone
+    assert ledger.all_done()
+    assert ledger.completed_units == 1 == ledger.total_units
+
+
+def test_ledger_rejects_empty_shapes():
+    with pytest.raises(ValueError):
+        UnitLedger(0, [0])
+    with pytest.raises(ValueError):
+        UnitLedger(1, [])
+
+
+# --------------------------------------------------------------------------
+# ElasticWorkerPool (fake clients — no parameter server, no wire)
+# --------------------------------------------------------------------------
+
+
+class _FakeClient:
+    """Liveness surface only; shared beat log stands in for the PS."""
+
+    def __init__(self, table):
+        self._table = table
+
+    def heartbeat(self, worker_id):
+        pass
+
+    def membership(self):
+        return dict(self._table)
+
+    def health(self):
+        return True
+
+    def deregister(self, worker_id):
+        pass
+
+    def close(self):
+        pass
+
+
+def test_pool_drains_ledger_and_reports_stats():
+    ledger = UnitLedger(3, [0, 1])
+    done = []
+    fired = []
+    pool = ElasticWorkerPool(
+        ledger,
+        run_unit=lambda wid, client, unit: done.append((wid, unit)) or {"n": 1},
+        client_factory=lambda wid: _FakeClient({}),
+        worker_ids=["w0", "w1"],
+        on_epoch_complete=fired.append,
+        monitor_poll=0.005, idle_wait=0.001,
+    )
+    pool.start()
+    stats = pool.wait()
+    assert stats["completed_units"] == 6
+    assert stats["requeued_units"] == 0
+    assert fired == [0, 1, 2]  # every epoch fires exactly once, in order
+    assert len(done) == 6
+    assert pool.epoch_metrics()[2][1] == {"n": 1}
+
+
+def test_pool_requeues_injected_death_to_survivor():
+    ledger = UnitLedger(2, [0, 1])
+    ran = []
+    plan = FaultPlan(seed=1, kill_worker_at={"w0": 1})
+    pool = ElasticWorkerPool(
+        ledger,
+        run_unit=lambda wid, client, unit: ran.append(wid) or {},
+        client_factory=lambda wid: _FakeClient({}),
+        worker_ids=["w0", "w1"],
+        injector=FaultInjector(plan),
+        monitor_poll=0.005, idle_wait=0.001,
+    )
+    pool.start()
+    stats = pool.wait()
+    assert stats["completed_units"] == 4  # exact despite the death
+    deaths = stats["worker_deaths"]
+    assert [d["worker"] for d in deaths] == ["w0"]
+    assert deaths[0]["reason"] == "injected kill"
+    assert set(ran) <= {"w0", "w1"} and ran.count("w0") == 1
+    assert stats["mttr_samples"]  # the repair window was measured
+
+
+def test_pool_rides_out_ps_outage_with_fresh_client():
+    """First unit on w0 raises ParameterServerUnavailable; the pool
+    re-queues it, polls health() on FRESH clients, and resumes. The
+    wire client stays fail-fast — recovery policy lives in the pool."""
+    ledger = UnitLedger(2, [0])
+    state = {"failed": False, "clients": 0}
+
+    def factory(worker_id):
+        state["clients"] += 1
+        return _FakeClient({})
+
+    def run_unit(worker_id, client, unit):
+        if not state["failed"]:
+            state["failed"] = True
+            raise ParameterServerUnavailable("boom")
+        return {}
+
+    pool = ElasticWorkerPool(
+        ledger, run_unit=run_unit, client_factory=factory,
+        worker_ids=["w0"], ps_recovery_grace=5.0,
+        monitor_poll=0.005, idle_wait=0.001,
+    )
+    pool.start()
+    stats = pool.wait()
+    assert stats["completed_units"] == 2
+    assert stats["requeued_units"] == 1
+    outages = stats["ps_outages"]
+    assert len(outages) == 1 and outages[0]["recovered"]
+    # The worker's initial client plus at least one FRESH post-outage
+    # client (the monitor's is lazy and may never materialize on a
+    # fast drain, so it can't be counted on).
+    assert state["clients"] >= 2
+
+
+def test_pool_fails_fast_when_ps_never_returns():
+    ledger = UnitLedger(1, [0])
+
+    class _DeadPSClient(_FakeClient):
+        def health(self):
+            return False
+
+    def run_unit(worker_id, client, unit):
+        raise ParameterServerUnavailable("gone for good")
+
+    pool = ElasticWorkerPool(
+        ledger, run_unit=run_unit,
+        client_factory=lambda wid: _DeadPSClient({}),
+        worker_ids=["w0"], ps_recovery_grace=0.05,
+        monitor_poll=0.005, idle_wait=0.001,
+    )
+    pool.start()
+    with pytest.raises(ParameterServerUnavailable, match="grace"):
+        pool.wait()
+    assert pool.stats["ps_outages"][0]["recovered"] is False
+
+
+def test_pool_admits_late_joiner():
+    ledger = UnitLedger(4, [0, 1])
+    gate = threading.Event()
+    ran = []
+
+    def run_unit(worker_id, client, unit):
+        gate.wait(5.0)  # hold units until the joiner is in
+        ran.append(worker_id)
+        return {}
+
+    pool = ElasticWorkerPool(
+        ledger, run_unit=run_unit,
+        client_factory=lambda wid: _FakeClient({}),
+        worker_ids=["w0"], monitor_poll=0.005, idle_wait=0.001,
+    )
+    pool.start()
+    pool.join_worker("late")
+    with pytest.raises(ValueError):
+        pool.join_worker("late")  # double-join while alive is a bug
+    gate.set()
+    stats = pool.wait()
+    assert stats["completed_units"] == 8
+    assert stats["late_joins"] == ["late"]
+    assert "late" in ran
+
+
+def test_pool_fences_detector_dead_worker():
+    """A worker the detector declared dead must exit instead of leasing
+    more work — its revival path is join_worker, not a quiet resume."""
+    ledger = UnitLedger(50, [0])
+    table = {"w0": {"state": "dead"}}
+    started = threading.Event()
+
+    def run_unit(worker_id, client, unit):
+        started.wait(5.0)
+        return {}
+
+    pool = ElasticWorkerPool(
+        ledger, run_unit=run_unit,
+        client_factory=lambda wid: _FakeClient(table),
+        worker_ids=["w0", "w1"], monitor_poll=0.005, idle_wait=0.001,
+    )
+    pool.start()
+    while pool.membership.state("w0") != "dead":  # monitor publishes
+        pass
+    started.set()
+    stats = pool.wait()
+    assert stats["completed_units"] == 50  # w1 finished everything
+    assert "w0" in stats["fenced"]
+
+
+# --------------------------------------------------------------------------
+# Injected-sleep retry schedules (satellite: no real waits in tier-1)
+# --------------------------------------------------------------------------
+
+
+def test_retry_connect_backoff_schedule_then_typed_error():
+    sleeps = SleepRecorder()
+    calls = {"n": 0}
+
+    def always_refused():
+        calls["n"] += 1
+        raise ConnectionRefusedError("nope")
+
+    with pytest.raises(ParameterServerUnavailable, match="during pull"):
+        _retry_connect(always_refused, "host:1", "pull", sleep=sleeps)
+    assert tuple(sleeps.calls) == _RETRY_DELAYS  # the exact schedule
+    assert calls["n"] == len(_RETRY_DELAYS) + 1  # initial try + retries
+
+
+def test_retry_connect_stops_sleeping_on_success():
+    sleeps = SleepRecorder()
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise ConnectionResetError("hiccup")
+        return "ok"
+
+    assert _retry_connect(flaky, "host:1", "push", sleep=sleeps) == "ok"
+    assert tuple(sleeps.calls) == _RETRY_DELAYS[:2]  # no schedule overrun
+
+
+def test_comms_pipeline_push_retry_backoff_and_counter():
+    from elephas_tpu import obs
+    from elephas_tpu.engine.async_engine import _CommsPipeline
+
+    counter = obs.default_registry().counter("ps_push_retry_total")
+    before = counter.value
+    sleeps = SleepRecorder()
+    pushes = {"n": 0}
+
+    class _FlakyPushClient:
+        def update_parameters(self, delta):
+            pushes["n"] += 1
+            if pushes["n"] <= 2:
+                raise RuntimeError("transient 500")
+
+        def get_parameters(self):
+            return {}
+
+    comms = _CommsPipeline(_FlakyPushClient(), 0, max_push_attempts=4,
+                           sleep=sleeps)
+    try:
+        comms.push({"params": {}})
+        comms.flush()
+    finally:
+        comms.close()
+    assert pushes["n"] == 3  # two transient failures, then success
+    assert sleeps.calls == [0.05, 0.1]  # _PUSH_RETRY_DELAYS prefix
+    assert counter.value == before + 2
+
+
+def test_comms_pipeline_push_never_retries_unavailable():
+    """ParameterServerUnavailable is infrastructure death: the pipeline
+    records it as fatal without burning the retry schedule (a re-sent
+    delta could double-apply on a healthy-again server)."""
+    from elephas_tpu.engine.async_engine import _CommsPipeline
+
+    sleeps = SleepRecorder()
+    pushes = {"n": 0}
+
+    class _DeadClient:
+        def update_parameters(self, delta):
+            pushes["n"] += 1
+            raise ParameterServerUnavailable("gone")
+
+    comms = _CommsPipeline(_DeadClient(), 0, max_push_attempts=4,
+                           sleep=sleeps)
+    try:
+        comms.push({"params": {}})
+        with pytest.raises(ParameterServerUnavailable):
+            comms.flush()
+    finally:
+        comms.close()
+    assert pushes["n"] == 1 and sleeps.calls == []
+
+
+def test_barrier_timeout_env_hardening(monkeypatch):
+    """A malformed ELEPHAS_BARRIER_TIMEOUT warns and takes the 600s
+    default instead of crashing fit teardown (satellite: env parsing
+    hardening). The barrier satisfies immediately, so no real waiting."""
+    from elephas_tpu.parameter.client import _WireBarrierMixin
+
+    class _InstantBarrier(_WireBarrierMixin):
+        def barrier_arrive(self, tag):
+            return 1
+
+        def barrier_count(self, tag):
+            return 1
+
+    monkeypatch.setenv("ELEPHAS_BARRIER_TIMEOUT", "ten-minutes")
+    with pytest.warns(RuntimeWarning, match="ELEPHAS_BARRIER_TIMEOUT"):
+        _InstantBarrier().wait_barrier("teardown", 1, timeout=None)
