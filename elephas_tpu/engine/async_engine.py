@@ -101,6 +101,15 @@ class _CommsPipeline:
     each epoch boundary BEFORE ``on_epoch_done`` so the barrier snapshot
     (validation/checkpoint) sees all of this worker's epoch pushes; it
     deliberately does not wait on a pending prefetch.
+
+    Trace carriage: contextvars don't cross the queue hop, so every
+    enqueue captures the worker's active trace context (and the enqueue
+    timestamp) into the item; the comms thread re-activates it around
+    the wire op — the client's ``ps/push``/``ps/pull`` spans, and the
+    PS-side handle spans they propagate to, land in the unit's causal
+    tree even though they ran on this thread. The enqueue→dequeue wait
+    is recorded as a ``comms/queued`` span: the "queue" phase of the
+    per-unit critical-path table.
     """
 
     # Backoff between same-delta push retries: a transient server hiccup
@@ -116,6 +125,7 @@ class _CommsPipeline:
         self._client = client
         self._sleep = sleep
         self._max_push_attempts = max(1, max_push_attempts)
+        self._worker_label = f"w{worker_index}"
         self._queue: queue.Queue = queue.Queue(maxsize=3)
         self._fatal: Optional[BaseException] = None
         self._pending: Optional[_PullBox] = None
@@ -136,7 +146,7 @@ class _CommsPipeline:
             return
         box = _PullBox()
         self._pending = box
-        self._put(("pull", box))
+        self._put(self._item("pull", box))
 
     def pull(self):
         """Consume the pending prefetch (or issue a synchronous pull),
@@ -145,7 +155,7 @@ class _CommsPipeline:
         box, self._pending = self._pending, None
         if box is None:
             box = _PullBox()
-            self._put(("pull", box))
+            self._put(self._item("pull", box))
         box.event.wait()
         if box.error is not None:
             raise box.error
@@ -157,7 +167,7 @@ class _CommsPipeline:
         self._raise_if_fatal()
         with self._push_cond:
             self._pushes_enqueued += 1
-        self._put(("push", delta))
+        self._put(self._item("push", delta))
 
     def flush(self) -> None:
         with self._push_cond:
@@ -170,11 +180,20 @@ class _CommsPipeline:
         closing the client — a stray prefetch otherwise races the close."""
         if self._thread is None:
             return
-        self._put(("stop", None))
+        self._put(("stop", None, None, None))
         self._thread.join()
         self._thread = None
 
     # -- comms thread ---------------------------------------------------
+
+    @staticmethod
+    def _item(kind, payload):
+        # Snapshot the worker's trace context + enqueue time: contextvars
+        # don't cross the queue hop, and the wait itself is the unit's
+        # "queue" phase.
+        tracer = obs.default_tracer()
+        return (kind, payload, obs.current_context(),
+                tracer.clock() if tracer.enabled else None)
 
     def _raise_if_fatal(self) -> None:
         if self._fatal is not None:
@@ -192,30 +211,35 @@ class _CommsPipeline:
 
     def _loop(self) -> None:
         while True:
-            kind, payload = self._queue.get()
+            kind, payload, ctx, enqueue_t = self._queue.get()
             if kind == "stop":
                 return
-            if kind == "pull":
-                box = payload
-                if self._fatal is not None:
-                    box.error = self._fatal
+            with obs.activate(ctx):
+                tracer = obs.default_tracer()
+                if enqueue_t is not None and tracer.enabled:
+                    tracer.record("comms/queued", enqueue_t, tracer.clock(),
+                                  op=kind, worker=self._worker_label)
+                if kind == "pull":
+                    box = payload
+                    if self._fatal is not None:
+                        box.error = self._fatal
+                        box.event.set()
+                        continue
+                    try:
+                        box.value = self._client.get_parameters()
+                    except BaseException as exc:
+                        box.error = exc
+                        if isinstance(exc, ParameterServerUnavailable):
+                            self._fatal = exc
                     box.event.set()
-                    continue
-                try:
-                    box.value = self._client.get_parameters()
-                except BaseException as exc:
-                    box.error = exc
-                    if isinstance(exc, ParameterServerUnavailable):
-                        self._fatal = exc
-                box.event.set()
-            else:  # push
-                try:
-                    if self._fatal is None:
-                        self._push_with_retry(payload)
-                finally:
-                    with self._push_cond:
-                        self._pushes_done += 1
-                        self._push_cond.notify_all()
+                else:  # push
+                    try:
+                        if self._fatal is None:
+                            self._push_with_retry(payload)
+                    finally:
+                        with self._push_cond:
+                            self._pushes_done += 1
+                            self._push_cond.notify_all()
 
     def _push_with_retry(self, delta) -> None:
         for attempt in range(self._max_push_attempts):
@@ -232,7 +256,8 @@ class _CommsPipeline:
                 obs.default_registry().counter(
                     "ps_push_retry_total",
                     help="background same-delta push retries (pipelined comms)",
-                ).inc()
+                    labelnames=("worker",),
+                ).labels(worker=self._worker_label).inc()
                 self._sleep(self._PUSH_RETRY_DELAYS[
                     min(attempt, len(self._PUSH_RETRY_DELAYS) - 1)
                 ])
@@ -1130,6 +1155,20 @@ class AsyncTrainer:
                 return host_rows[part]
 
         def run_unit(worker_id: str, client, unit):
+            # Each (epoch, partition) unit roots its own trace: the
+            # pull→train→push→PS-apply chain below — including a push
+            # retried against a warm-restarted server — is one causal
+            # tree (PS-side spans carry the boot id of the incarnation
+            # that served them).
+            epoch, part = unit
+            tracer = obs.default_tracer()
+            ctx = obs.new_context() if tracer.enabled else None
+            with obs.activate(ctx), tracer.span(
+                    "async/unit", epoch=epoch, partition=part,
+                    worker=worker_id):
+                return unit_body(worker_id, client, unit)
+
+        def unit_body(worker_id: str, client, unit):
             epoch, part = unit
             device = device_for(worker_id)
             x, y, nb, usable = partition_rows(part)
@@ -1168,12 +1207,15 @@ class AsyncTrainer:
                 rng=jax.device_put(unit_rng, device),
                 step=epoch * nb,
             )
-            new_state, metrics = self._epoch_fn(state0, ex, ey)
-            # Force the scan BEFORE pushing — a device fault must kill
-            # this unit (re-queued by the pool), never poison the buffer.
-            fetched = {
-                k: float(v) for k, v in jax.device_get(metrics).items()
-            }
+            with obs.default_tracer().span("async/train", worker=worker_id,
+                                           epoch=epoch):
+                new_state, metrics = self._epoch_fn(state0, ex, ey)
+                # Force the scan BEFORE pushing — a device fault must
+                # kill this unit (re-queued by the pool), never poison
+                # the buffer.
+                fetched = {
+                    k: float(v) for k, v in jax.device_get(metrics).items()
+                }
             client.update_parameters({
                 "params": self._subtract(state0.params, new_state.params),
                 "batch_stats": self._subtract(
@@ -1429,7 +1471,7 @@ class AsyncTrainer:
                     # fetch + epoch-barrier work instead of training.
                     comms.prefetch()
 
-        def run_unit(unit):
+        def run_unit(unit, **unit_args):
             """Spark's ``spark.task.maxFailures`` analogue (SURVEY.md §5.3):
             ``unit(attempt)`` runs one frequency-unit from a fresh PS pull;
             a transient exception retries it (re-seeded stream) up to
@@ -1459,7 +1501,15 @@ class AsyncTrainer:
             nonlocal epoch_retries
             for attempt in range(self.max_failures):
                 try:
-                    return unit(attempt)
+                    # Each attempt roots its own trace: one causal tree
+                    # per pull→train→push chain, spanning the comms-
+                    # thread hop and the PS-side handle spans (which tag
+                    # the boot id of whichever incarnation served them).
+                    ctx = obs.new_context() if tracer.enabled else None
+                    with obs.activate(ctx), tracer.span(
+                            "async/unit", worker=index, attempt=attempt,
+                            **unit_args):
+                        return unit(attempt)
                 except ParameterServerUnavailable:
                     raise
                 except Exception:
@@ -1591,7 +1641,7 @@ class AsyncTrainer:
                         opt_state = state.opt_state
                         return out
 
-                    entry = run_unit(epoch_unit)
+                    entry = run_unit(epoch_unit, epoch=epoch, partition=index)
                     global_step += nb
                 else:  # 'batch': pull/push per step, batches from the chunk
                     perm = make_perm(epoch, 0)
@@ -1624,7 +1674,9 @@ class AsyncTrainer:
                                 opt_state = new_state.opt_state
                                 return metrics
 
-                            device_metrics.append(run_unit(batch_unit))
+                            device_metrics.append(run_unit(
+                                batch_unit, epoch=epoch, partition=index,
+                                step=global_step))
                             global_step += 1
                         prev_last = len(device_metrics) - 1
                         buf = nxt
@@ -1702,7 +1754,7 @@ class AsyncTrainer:
                     opt_state = new_state.opt_state
                     return fetched
 
-                entry = run_unit(epoch_unit)
+                entry = run_unit(epoch_unit, epoch=epoch, partition=index)
                 global_step += nb
             else:  # frequency == 'batch': pull/push every step (reference cadence)
                 # Metrics stay on-device per step; one device_get per epoch.
@@ -1725,7 +1777,9 @@ class AsyncTrainer:
                         opt_state = new_state.opt_state
                         return metrics
 
-                    device_metrics.append(run_unit(batch_unit))
+                    device_metrics.append(run_unit(
+                        batch_unit, epoch=epoch, partition=index,
+                        step=global_step))
                     global_step += 1
                 fetched = jax.device_get(device_metrics)
                 entry = {

@@ -306,7 +306,8 @@ class SyncTrainer:
 
         tracer = obs.default_tracer()
         epoch_hist = obs.default_registry().histogram(
-            "train_epoch_s", help="wall seconds per dispatched training epoch"
+            "train_epoch_seconds",
+            help="wall seconds per dispatched training epoch",
         )
         history: Dict[str, List[float]] = {}
         for epoch in range(epochs):

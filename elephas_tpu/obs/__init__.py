@@ -13,10 +13,18 @@ record through them):
   (every span is a no-op); ``enable_tracing()`` swaps in a live ring.
 - ``default_registry()`` — always live (counters/gauges/histograms are
   a few ints each); scrape with ``default_registry().expose_text()``.
+- ``default_flight_recorder()`` — bounded anomaly ring (retrace storms,
+  heartbeat flaps, rejections, WAL restores); live by default since
+  anomalies are rare by construction, swappable for tests via
+  ``set_default_flight_recorder()``.
 
 The serving ``InferenceEngine`` instead takes an explicit ``tracer=``
 (its clock is injectable and the tracer must share it); it falls back
 to the global default when none is passed.
+
+Distributed trace context rides along from ``obs.trace``:
+``new_context()``/``activate()``/``current_context()`` are re-exported
+here so call sites can root and adopt traces without a second import.
 """
 
 from __future__ import annotations
@@ -26,6 +34,7 @@ from typing import Optional
 
 from elephas_tpu.obs.registry import (  # noqa: F401
     Counter,
+    Family,
     Gauge,
     Histogram,
     MetricsRegistry,
@@ -34,11 +43,21 @@ from elephas_tpu.obs.registry import (  # noqa: F401
 from elephas_tpu.obs.trace import (  # noqa: F401
     NULL_TRACER,
     SpanEvent,
+    TraceContext,
     Tracer,
+    activate,
+    current_context,
+    new_context,
+)
+from elephas_tpu.obs.flight import (  # noqa: F401
+    NULL_FLIGHT_RECORDER,
+    FlightEvent,
+    FlightRecorder,
 )
 
 _tracer: Tracer = NULL_TRACER
 _registry = MetricsRegistry()
+_flight = FlightRecorder()
 
 
 def default_tracer() -> Tracer:
@@ -70,3 +89,16 @@ def disable_tracing() -> None:
 def default_registry() -> MetricsRegistry:
     """The process-global metrics registry (always live)."""
     return _registry
+
+
+def default_flight_recorder() -> FlightRecorder:
+    """The process-global anomaly ring (live by default)."""
+    return _flight
+
+
+def set_default_flight_recorder(
+        recorder: Optional[FlightRecorder]) -> FlightRecorder:
+    """Install ``recorder`` as the global default (None → disabled)."""
+    global _flight
+    _flight = recorder if recorder is not None else NULL_FLIGHT_RECORDER
+    return _flight

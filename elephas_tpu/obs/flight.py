@@ -1,0 +1,160 @@
+"""Flight recorder: bounded ring of structured anomaly events.
+
+Metrics answer "how many rejections"; traces answer "where the time
+went"; neither answers "what went *wrong* around 14:03:07, in what
+order, on which trace" after a PS kill. The flight recorder is that
+third surface: every anomaly the system already detects — retrace
+storms, heartbeat flaps, stale-delta rejections, backpressure
+rejections, deadline evictions, WAL restores — drops one structured
+event into a bounded ring, tagged with severity and the trace context
+active at the anomaly site, so a merged chaos trace and the anomaly log
+join on trace id.
+
+Recording is a clock read + dict build + deque append under a small
+lock — cheap enough to stay on unconditionally (anomalies are rare by
+definition; a recorder hot enough to matter is itself the anomaly, and
+the ring bounds the damage). The ring keeps the *recent* past and
+counts what it overwrites (``dropped``), mirroring the span tracer's
+truncation honesty.
+
+Read-out paths: ``events()``/``snapshot()`` for tests and the ops
+endpoint's ``/flight`` route; ``dump(path)`` for the crash path — PS
+``kill()`` writes the ring to disk *before* severing connections, so a
+post-mortem has the anomaly log even though the process skipped every
+clean-shutdown sync.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from elephas_tpu.obs import trace as _trace
+
+__all__ = ["FlightEvent", "FlightRecorder", "NULL_FLIGHT_RECORDER"]
+
+#: Allowed severities, in increasing order of alarm.
+SEVERITIES = ("info", "warn", "error")
+
+
+class FlightEvent:
+    """One recorded anomaly."""
+
+    __slots__ = ("kind", "severity", "wall_s", "mono_s", "trace_id",
+                 "detail")
+
+    def __init__(self, kind: str, severity: str, wall_s: float,
+                 mono_s: float, trace_id: Optional[str],
+                 detail: Dict[str, Any]):
+        self.kind = kind
+        self.severity = severity
+        self.wall_s = wall_s
+        self.mono_s = mono_s
+        self.trace_id = trace_id
+        self.detail = detail
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "severity": self.severity,
+            "wall_s": self.wall_s,
+            "mono_s": self.mono_s,
+            "trace_id": self.trace_id,
+            "detail": self.detail,
+        }
+
+    def __repr__(self):
+        return (f"FlightEvent({self.kind!r}, {self.severity}, "
+                f"trace={self.trace_id}, {self.detail})")
+
+
+class FlightRecorder:
+    """Bounded anomaly ring.
+
+    ``enabled=False`` makes ``note()`` a single attribute check —
+    ``NULL_FLIGHT_RECORDER`` is the shared disabled instance, so
+    instrumented code can hold a recorder unconditionally.
+    """
+
+    def __init__(self, capacity: int = 1024, enabled: bool = True,
+                 clock=time.monotonic):
+        if capacity < 0:
+            raise ValueError(f"capacity must be >= 0, got {capacity}")
+        self.capacity = capacity
+        self.enabled = enabled
+        self.clock = clock
+        self.dropped = 0
+        self._events: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def note(self, kind: str, severity: str = "warn",
+             **detail) -> Optional[FlightEvent]:
+        """Record one anomaly, tagged with the active trace context.
+
+        ``kind`` is a stable snake_case event name (``retrace_storm``,
+        ``heartbeat_flap``, ``backpressure_reject``, ...); ``detail``
+        holds the site-specific facts (worker id, depth, version delta).
+        """
+        if not self.enabled:
+            return None
+        if severity not in SEVERITIES:
+            raise ValueError(
+                f"severity must be one of {SEVERITIES}, got {severity!r}"
+            )
+        ctx = _trace.current_context()
+        event = FlightEvent(kind, severity, time.time(), self.clock(),
+                            ctx.trace_id if ctx is not None else None,
+                            detail)
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(event)
+        return event
+
+    # -- read-out ----------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def events(self, kind: Optional[str] = None,
+               min_severity: str = "info") -> List[FlightEvent]:
+        """Ring snapshot (oldest first), optionally filtered."""
+        floor = SEVERITIES.index(min_severity)
+        with self._lock:
+            out = list(self._events)
+        return [e for e in out
+                if (kind is None or e.kind == kind)
+                and SEVERITIES.index(e.severity) >= floor]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready dump — the ``/flight`` ops route and ``dump()``
+        both serve exactly this."""
+        events = self.events()
+        counts: Dict[str, int] = {}
+        for e in events:
+            counts[e.kind] = counts.get(e.kind, 0) + 1
+        return {
+            "events": [e.to_dict() for e in events],
+            "counts_by_kind": counts,
+            "dropped": self.dropped,
+            "capacity": self.capacity,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def dump(self, path: str) -> str:
+        """Write the ring to ``path`` as JSON (crash-path artifact).
+        Returns the path."""
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f, indent=1)
+        return path
+
+
+#: Shared disabled instance — hold it unconditionally in instrumented code.
+NULL_FLIGHT_RECORDER = FlightRecorder(capacity=0, enabled=False)

@@ -1,0 +1,177 @@
+"""opsd: threaded HTTP introspection endpoint for live processes.
+
+Every long-lived process in the system — PS servers, the serving
+``InferenceEngine`` frontend — can mount one of these and answer, while
+under load, the questions that today require attaching a debugger:
+
+- ``GET /metrics`` — Prometheus text exposition of the process registry
+  (scrapeable by a stock Prometheus server);
+- ``GET /healthz`` — liveness + an optional health summary (PS servers
+  wire their ``MembershipView``/failure-detector state in);
+- ``GET /trace``   — the span ring as Chrome-trace JSON *with the
+  clockSync block*, which is exactly the per-process dump
+  ``scripts/trace_report.py --merge`` aligns across machines;
+- ``GET /vars``    — process identity and config (boot id, buffer
+  version, bind address) for "which incarnation am I talking to";
+- ``GET /flight``  — the anomaly flight-recorder ring.
+
+Security: opsd binds **loopback by default** (``127.0.0.1``). It serves
+unauthenticated process internals — trace args can contain request ids
+and config values — so exposing it beyond the host is an explicit
+decision: pass ``host=`` or set ``ELEPHAS_OPS_BIND``. This mirrors the
+PS servers' own ``ELEPHAS_PS_BIND`` convention.
+
+The server is a ``ThreadingHTTPServer`` on a daemon thread: requests
+never touch the training/serving hot paths beyond the GIL, handlers
+only *read* shared structures (registry exposition and ring snapshots
+are already lock-guarded copies), and ``stop()`` is idempotent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+__all__ = ["OpsServer"]
+
+
+def _default_bind_host() -> str:
+    # Loopback unless the operator explicitly opts into exposure.
+    return os.environ.get("ELEPHAS_OPS_BIND", "127.0.0.1")
+
+
+class OpsServer:
+    """Mountable introspection endpoint (see module docstring).
+
+    Parameters
+    ----------
+    port: TCP port; 0 picks a free one (read ``.port`` after
+        ``start()``).
+    host: bind address; defaults to loopback / ``ELEPHAS_OPS_BIND``.
+    registry / tracer / flight: the surfaces to serve; default to the
+        process-global ones resolved lazily at request time (so a
+        later ``enable_tracing()`` is picked up without a remount).
+    vars_fn: extra ``/vars`` content, e.g. the PS server's boot id and
+        buffer version — called per request so values are live.
+    health_fn: extra ``/healthz`` content (membership summary). If it
+        raises, ``/healthz`` answers 500 — a health route that lies is
+        worse than one that fails.
+    """
+
+    def __init__(self, port: int = 0, host: Optional[str] = None,
+                 registry=None, tracer=None, flight=None,
+                 vars_fn: Optional[Callable[[], Dict]] = None,
+                 health_fn: Optional[Callable[[], Dict]] = None):
+        self._requested_port = port
+        self.host = host if host is not None else _default_bind_host()
+        self._registry = registry
+        self._tracer = tracer
+        self._flight = flight
+        self._vars_fn = vars_fn
+        self._health_fn = health_fn
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started_wall = None
+        self.port: Optional[int] = None
+
+    # Lazy resolution: a tracer enabled after mount is still served.
+    def _get_registry(self):
+        if self._registry is not None:
+            return self._registry
+        from elephas_tpu import obs
+        return obs.default_registry()
+
+    def _get_tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from elephas_tpu import obs
+        return obs.default_tracer()
+
+    def _get_flight(self):
+        if self._flight is not None:
+            return self._flight
+        from elephas_tpu import obs
+        return obs.default_flight_recorder()
+
+    def start(self) -> "OpsServer":
+        if self._httpd is not None:
+            return self
+        ops = self
+        self._started_wall = time.time()
+
+        class Handler(BaseHTTPRequestHandler):
+            # opsd must never spam the process stdout per scrape.
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      content_type: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, doc) -> None:
+                self._send(code, json.dumps(doc).encode())
+
+            def do_GET(self):  # noqa: N802
+                try:
+                    if self.path == "/metrics":
+                        text = ops._get_registry().expose_text()
+                        self._send(200, text.encode(),
+                                   "text/plain; version=0.0.4")
+                    elif self.path == "/healthz":
+                        doc = {"status": "ok",
+                               "uptime_s": time.time() - ops._started_wall}
+                        if ops._health_fn is not None:
+                            doc.update(ops._health_fn())
+                        self._send_json(200, doc)
+                    elif self.path == "/trace":
+                        self._send_json(200,
+                                        ops._get_tracer().export_chrome())
+                    elif self.path == "/vars":
+                        doc = {"pid": os.getpid(),
+                               "ops_host": ops.host,
+                               "ops_port": ops.port}
+                        if ops._vars_fn is not None:
+                            doc.update(ops._vars_fn())
+                        self._send_json(200, doc)
+                    elif self.path == "/flight":
+                        self._send_json(200, ops._get_flight().snapshot())
+                    else:
+                        self._send_json(404, {"error": "not found",
+                                              "path": self.path})
+                except Exception as exc:  # surface, don't hang the scrape
+                    try:
+                        self._send_json(500, {"error": repr(exc)})
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"opsd:{self.port}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

@@ -14,6 +14,15 @@ percentiles linearly interpolated inside the owning bucket and clamped
 to the observed min/max, so the estimate is never wider than one bucket
 off the exact quantile (tests pin this against exact quantiles on known
 distributions).
+
+Labels (this PR): ``registry.counter("ps_push_retry_total",
+labelnames=("worker",))`` returns a ``Family``; ``.labels(worker="w1")``
+get-or-creates the child instrument. One metric name, N label-keyed
+children — instead of N metric names with the dimension baked in
+(``retrace_total::prog``), which Prometheus can neither aggregate nor
+relabel. ``Family.value`` sums the children, so "total across the
+dimension" reads stay one attribute access. Exposition renders
+``name{worker="w1"} 3`` with proper label-value escaping.
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "Family",
     "MetricsRegistry",
     "default_latency_buckets",
 ]
@@ -37,14 +47,35 @@ def default_latency_buckets() -> Tuple[float, ...]:
     return tuple(1e-5 * 2 ** i for i in range(24))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition escaping: backslash, quote, newline."""
+    return (v.replace("\\", r"\\").replace('"', r"\"")
+             .replace("\n", r"\n"))
+
+
+def _render_labels(labels: Dict[str, str], **extra: str) -> str:
+    """``{k="v",...}`` suffix for a sample line; "" when empty.
+
+    ``extra`` appends synthetic labels (the histogram ``le`` bound)
+    after the family's own, matching Prometheus ordering convention.
+    """
+    items = list(labels.items()) + list(extra.items())
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_escape_label_value(str(v))}"'
+                    for k, v in items)
+    return "{" + body + "}"
+
+
 class Counter:
     """Monotonic counter (``inc`` only)."""
 
-    __slots__ = ("name", "help", "_value")
+    __slots__ = ("name", "help", "labels", "_value")
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
+        self.labels = None  # set by the owning Family, if any
         self._value = 0
 
     def inc(self, n: int = 1) -> None:
@@ -58,11 +89,12 @@ class Counter:
 class Gauge:
     """Last-write-wins scalar."""
 
-    __slots__ = ("name", "help", "_value")
+    __slots__ = ("name", "help", "labels", "_value")
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
+        self.labels = None  # set by the owning Family, if any
         self._value = 0.0
 
     def set(self, v: float) -> None:
@@ -83,13 +115,14 @@ class Histogram:
     as ten.
     """
 
-    __slots__ = ("name", "help", "bounds", "counts", "count", "sum",
-                 "min", "max")
+    __slots__ = ("name", "help", "labels", "bounds", "counts", "count",
+                 "sum", "min", "max")
 
     def __init__(self, name: str, help: str = "",
                  buckets: Optional[Iterable[float]] = None):
         self.name = name
         self.help = help
+        self.labels = None  # set by the owning Family, if any
         bounds = tuple(sorted(buckets)) if buckets is not None \
             else default_latency_buckets()
         if not bounds:
@@ -154,11 +187,65 @@ class Histogram:
         return {f"p{int(q * 100)}": self.percentile(q) for q in qs}
 
 
+class Family:
+    """A labeled metric: one name, one label schema, N children keyed by
+    label values. ``family.labels(worker="w1")`` get-or-creates the
+    child instrument (a plain Counter/Gauge/Histogram whose ``labels``
+    attr holds the key→value dict the exposition renders).
+
+    ``value`` sums the children (counters/gauges), so call sites that
+    read "the total across the dimension" don't need to enumerate.
+    """
+
+    __slots__ = ("name", "help", "cls", "labelnames", "_kw",
+                 "_children", "_lock")
+
+    def __init__(self, cls, name: str, help: str,
+                 labelnames: Tuple[str, ...], **kw):
+        if not labelnames:
+            raise ValueError("Family needs at least one label name")
+        self.name = name
+        self.help = help
+        self.cls = cls
+        self.labelnames = tuple(labelnames)
+        self._kw = kw
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **kv):
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(kv))}"
+            )
+        key = tuple(str(kv[k]) for k in self.labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    child = self.cls(self.name, help=self.help, **self._kw)
+                    child.labels = dict(zip(self.labelnames, key))
+                    self._children[key] = child
+        return child
+
+    def children(self) -> List[object]:
+        """Children sorted by label values (stable exposition order)."""
+        with self._lock:
+            return [self._children[k] for k in sorted(self._children)]
+
+    @property
+    def value(self):
+        """Sum across children (counter/gauge families)."""
+        return sum(c.value for c in self.children())
+
+
 class MetricsRegistry:
     """Name → instrument map with get-or-create accessors.
 
     Accessors are idempotent (same name returns the same instrument) and
-    kind-checked — registering ``"x"`` as both a counter and a gauge is
+    kind-checked — registering ``"x"`` as both a counter and a gauge, or
+    as both plain and labeled (or with two different label schemas), is
     a programming error worth failing loudly on.
     """
 
@@ -166,12 +253,32 @@ class MetricsRegistry:
         self._instruments: Dict[str, object] = {}
         self._lock = threading.Lock()
 
-    def _get_or_create(self, cls, name: str, help: str, **kw):
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Tuple[str, ...] = (), **kw):
         with self._lock:
             inst = self._instruments.get(name)
             if inst is None:
-                inst = cls(name, help=help, **kw)
+                if labelnames:
+                    inst = Family(cls, name, help, tuple(labelnames), **kw)
+                else:
+                    inst = cls(name, help=help, **kw)
                 self._instruments[name] = inst
+            elif isinstance(inst, Family):
+                if inst.cls is not cls:
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{inst.cls.__name__} family, not {cls.__name__}"
+                    )
+                if tuple(labelnames) != inst.labelnames:
+                    raise TypeError(
+                        f"metric {name!r} already registered with labels "
+                        f"{inst.labelnames}, not {tuple(labelnames)}"
+                    )
+            elif labelnames:
+                raise TypeError(
+                    f"metric {name!r} already registered unlabeled; "
+                    f"cannot re-register with labels {tuple(labelnames)}"
+                )
             elif not isinstance(inst, cls):
                 raise TypeError(
                     f"metric {name!r} already registered as "
@@ -179,15 +286,20 @@ class MetricsRegistry:
                 )
             return inst
 
-    def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(Counter, name, help)
+    def counter(self, name: str, help: str = "",
+                labelnames: Tuple[str, ...] = ()):
+        return self._get_or_create(Counter, name, help,
+                                   labelnames=labelnames)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "",
+              labelnames: Tuple[str, ...] = ()):
+        return self._get_or_create(Gauge, name, help, labelnames=labelnames)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Optional[Iterable[float]] = None) -> Histogram:
-        return self._get_or_create(Histogram, name, help, buckets=buckets)
+                  buckets: Optional[Iterable[float]] = None,
+                  labelnames: Tuple[str, ...] = ()):
+        return self._get_or_create(Histogram, name, help,
+                                   labelnames=labelnames, buckets=buckets)
 
     def instruments(self) -> List[object]:
         with self._lock:
@@ -200,42 +312,71 @@ class MetricsRegistry:
     # -- readout -----------------------------------------------------------
 
     def expose_text(self) -> str:
-        """Prometheus-style text exposition (scrape/dump surface)."""
+        """Prometheus-style text exposition (scrape/dump surface).
+
+        Labeled families emit one HELP/TYPE header and one sample line
+        per child (``name{worker="w1"} 3``); labeled histograms merge
+        the family labels with ``le`` on every bucket line.
+        """
         lines: List[str] = []
         for inst in self.instruments():
-            kind = type(inst).__name__.lower()
+            if isinstance(inst, Family):
+                kind = inst.cls.__name__.lower()
+                children = inst.children()
+            else:
+                kind = type(inst).__name__.lower()
+                children = [inst]
             if inst.help:
                 lines.append(f"# HELP {inst.name} {inst.help}")
             lines.append(f"# TYPE {inst.name} {kind}")
-            if isinstance(inst, Histogram):
-                cum = 0
-                for bound, c in zip(inst.bounds, inst.counts):
-                    cum += c
+            for child in children:
+                labels = child.labels or {}
+                if isinstance(child, Histogram):
+                    cum = 0
+                    for bound, c in zip(child.bounds, child.counts):
+                        cum += c
+                        lines.append(
+                            f"{child.name}_bucket"
+                            f"{_render_labels(labels, le=f'{bound:g}')}"
+                            f" {cum}"
+                        )
                     lines.append(
-                        f'{inst.name}_bucket{{le="{bound:g}"}} {cum}'
+                        f"{child.name}_bucket"
+                        f"{_render_labels(labels, le='+Inf')} {child.count}"
                     )
-                lines.append(
-                    f'{inst.name}_bucket{{le="+Inf"}} {inst.count}'
-                )
-                lines.append(f"{inst.name}_sum {inst.sum:g}")
-                lines.append(f"{inst.name}_count {inst.count}")
-            else:
-                lines.append(f"{inst.name} {inst.value:g}")
+                    suffix = _render_labels(labels)
+                    lines.append(f"{child.name}_sum{suffix} {child.sum:g}")
+                    lines.append(f"{child.name}_count{suffix} {child.count}")
+                else:
+                    lines.append(
+                        f"{child.name}{_render_labels(labels)} "
+                        f"{child.value:g}"
+                    )
         return "\n".join(lines) + ("\n" if lines else "")
 
     def snapshot(self) -> Dict[str, float]:
         """Flat name → number dict; histograms expand to
-        ``_count``/``_sum``/``_p50``/``_p95``/``_p99``."""
+        ``_count``/``_sum``/``_p50``/``_p95``/``_p99``; labeled children
+        key as ``name{worker="w1"}``."""
         out: Dict[str, float] = {}
-        for inst in self.instruments():
-            if isinstance(inst, Histogram):
-                out[f"{inst.name}_count"] = inst.count
-                out[f"{inst.name}_sum"] = inst.sum
-                for key, v in inst.percentiles().items():
+
+        def emit(child):
+            suffix = _render_labels(child.labels or {})
+            if isinstance(child, Histogram):
+                out[f"{child.name}_count{suffix}"] = child.count
+                out[f"{child.name}_sum{suffix}"] = child.sum
+                for pk, v in child.percentiles().items():
                     if v is not None:
-                        out[f"{inst.name}_{key}"] = v
+                        out[f"{child.name}_{pk}{suffix}"] = v
             else:
-                out[inst.name] = inst.value
+                out[f"{child.name}{suffix}"] = child.value
+
+        for inst in self.instruments():
+            if isinstance(inst, Family):
+                for child in inst.children():
+                    emit(child)
+            else:
+                emit(inst)
         return out
 
     def log_to(self, sink, step: int = 0, **extra) -> None:

@@ -50,14 +50,30 @@ _RETRY_DELAYS = (0.1, 0.2, 0.4, 0.8, 1.3)
 _CONNECT_TIMEOUT = 2.0  # dial budget per attempt (transfers get self.timeout)
 
 
+# Literal metric names per op: the transport is a LABEL, not part of
+# the name (one series family Prometheus can sum/relabel), and the lint
+# naming rule can see the literals.
+_PS_OP_COUNTERS = {"pull": "ps_pull_total", "push": "ps_push_total"}
+
+
 def _ps_span(op: str, transport: str):
     """Span + counter for one PS round-trip; every client's pull/push
     funnels through here so ``ps/pull``/``ps/push`` rows mean the same
     thing across local, http, and socket transports. The wire clients
     ``note()`` payload bytes + codec onto the span (None-guarded: a
-    disabled tracer yields None)."""
-    obs.default_registry().counter(f"ps_{op}_total").inc()
+    disabled tracer yields None) and read ``sp.context`` for the
+    ``(trace_id, span_id)`` pair to ship on the wire."""
+    obs.default_registry().counter(
+        _PS_OP_COUNTERS[op], labelnames=("transport",)
+    ).labels(transport=transport).inc()
     return obs.default_tracer().span(f"ps/{op}", transport=transport)
+
+
+def _span_trace(sp):
+    """The wire-shippable ``(trace_id, span_id)`` of a live ``_ps_span``,
+    or None (disabled tracer, or no trace root active — untraced runs
+    keep the legacy wire shapes byte-identical)."""
+    return sp.context if sp else None
 
 
 def _resolve_codec(codec: Optional[str]) -> str:
@@ -129,6 +145,10 @@ class _PullCache:
         with self._lock:
             version, tree = self._version, self._tree
         if tree is None or not_modified.version != version:
+            obs.default_flight_recorder().note(
+                "stale_notmod", "error",
+                server_version=not_modified.version, client_version=version,
+            )
             raise RuntimeError(
                 "parameter server sent not-modified for version "
                 f"{not_modified.version} but this client last saw "
@@ -352,14 +372,20 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
     def _get(self, path: str, op: str, headers: Optional[dict] = None) -> bytes:
         return self._call("GET", path, None, op, headers=headers)
 
-    def _post(self, path: str, payload: bytes, op: str) -> bytes:
-        return self._call("POST", path, payload, op)
+    def _post(self, path: str, payload: bytes, op: str,
+              headers: Optional[dict] = None) -> bytes:
+        return self._call("POST", path, payload, op, headers=headers)
 
     def get_parameters(self):
         with _ps_span("pull", "http") as sp:
-            headers = None
+            headers = {}
+            tc = _span_trace(sp)
+            if tc is not None:
+                # Propagate our span identity: the server's handle span
+                # adopts it and becomes this pull's child in the merge.
+                headers["X-Elephas-Trace"] = f"{tc.trace_id}-{tc.span_id}"
             if self.codec == "packed":
-                headers = {"X-Elephas-Codec": "packed"}
+                headers["X-Elephas-Codec"] = "packed"
                 known = self._pull_cache.known()
                 if isinstance(known, tuple):
                     # (boot, version): the server only answers
@@ -369,7 +395,8 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
                     headers["X-Elephas-Version"] = str(known[1])
                 elif known is not None:
                     headers["X-Elephas-Version"] = str(known)
-            body = self._get("/parameters", "get_parameters", headers=headers)
+            body = self._get("/parameters", "get_parameters",
+                             headers=headers or None)
             # Magic negotiation: a legacy server ignores our codec header
             # and replies pickle — decode whatever actually came back.
             if wire.is_packed(body):
@@ -398,7 +425,12 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
             if sp:
                 sp.note(codec=codec, payload_bytes=len(payload),
                         quantize=self.push_quantize)
-            self._post("/update", payload, "update_parameters")
+            headers = None
+            tc = _span_trace(sp)
+            if tc is not None:
+                headers = {"X-Elephas-Trace": f"{tc.trace_id}-{tc.span_id}"}
+            self._post("/update", payload, "update_parameters",
+                       headers=headers)
 
     def health(self) -> bool:
         """One non-retried probe of ``GET /health``, bounded end-to-end by
@@ -541,7 +573,11 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
             # bare int against legacy peers, or None on a cold cache —
             # the server only answers not-modified for a matching pair.
             known = self._pull_cache.known()
-            reply = self._roundtrip(("G", known), "get_parameters",
+            tc = _span_trace(sp)
+            # Trace context rides as an OPTIONAL third element — untraced
+            # runs keep the legacy 2-tuple a pre-PR-6 server expects.
+            frame = ("G", known) if tc is None else ("G", known, tuple(tc))
+            reply = self._roundtrip(frame, "get_parameters",
                                     idempotent=True)
             if not isinstance(reply, (bytes, bytearray, memoryview)):
                 raise RuntimeError(
@@ -563,7 +599,11 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
     def update_parameters(self, delta) -> None:
         with _ps_span("push", "socket") as sp:
             delta = jax.device_get(delta)
-            frame, codec, nbytes = ("u", delta), "pickle", None
+            tc = _span_trace(sp)
+            # Legacy-pickle frames carry the context as an optional third
+            # element; packed frames carry it in their own header ("tc").
+            frame = ("u", delta) if tc is None else ("u", delta, tuple(tc))
+            codec, nbytes = "pickle", None
             if self.codec == "packed":
                 try:
                     # The Frames go to the socket as scatter-gather
@@ -572,7 +612,8 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
                     # its magic. Unpackable structures ride the legacy
                     # ('u', delta) frame instead.
                     frames = wire.encode_tree(delta,
-                                              quantize=self.push_quantize)
+                                              quantize=self.push_quantize,
+                                              trace=tc)
                     frame, codec, nbytes = frames, "packed", frames.nbytes
                 except wire.WireFormatError:
                     pass

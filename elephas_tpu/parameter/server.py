@@ -33,6 +33,7 @@ import json
 import os
 import socket
 import socketserver
+import tempfile
 import threading
 import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -47,15 +48,45 @@ from elephas_tpu.parameter.buffer import ParameterBuffer
 from elephas_tpu.utils import sockets as socket_utils
 
 
-def _ps_counters():
-    """(cache_hit, bytes_tx, bytes_rx) server-side data-path counters."""
+def _ps_counters(transport: str):
+    """(cache_hit, bytes_tx, bytes_rx) server-side data-path counters.
+
+    The byte counters are one labeled family per direction — the
+    transport is a LABEL (``ps_bytes_tx_total{transport="socket"}``), so
+    Prometheus can sum across transports or split by one, instead of the
+    dimension being baked into per-transport metric names."""
     reg = obs.default_registry()
     return (
         reg.counter("ps_cache_hit_total",
                     "pulls answered with a not-modified frame"),
-        reg.counter("ps_bytes_tx", "payload bytes sent by the PS servers"),
-        reg.counter("ps_bytes_rx", "payload bytes received by the PS servers"),
+        reg.counter("ps_bytes_tx_total",
+                    "payload bytes sent by the PS servers",
+                    labelnames=("transport",)).labels(transport=transport),
+        reg.counter("ps_bytes_rx_total",
+                    "payload bytes received by the PS servers",
+                    labelnames=("transport",)).labels(transport=transport),
     )
+
+
+def _parse_trace_header(raw: Optional[str]):
+    """``X-Elephas-Trace: <trace_id>-<span_id>`` → TraceContext | None.
+    Malformed values are dropped, never fatal — tracing must not be able
+    to take down the data path."""
+    if not raw:
+        return None
+    trace_id, sep, span_id = raw.partition("-")
+    if not sep or not trace_id or not span_id:
+        return None
+    return obs.TraceContext(trace_id, span_id)
+
+
+def _as_trace_ctx(tc):
+    """A wire-carried ``(trace_id, span_id)`` pair → TraceContext | None
+    (tolerates lists from JSON headers and junk from old peers)."""
+    if (isinstance(tc, (tuple, list)) and len(tc) == 2
+            and all(isinstance(x, str) for x in tc)):
+        return obs.TraceContext(tc[0], tc[1])
+    return None
 
 
 def _new_boot_id() -> str:
@@ -118,6 +149,11 @@ def _attach_wal(buffer: ParameterBuffer, wal_dir: str, wal_every: int):
         pass  # cold start: serve the constructor params
     else:
         buffer.set(tree, version=version)
+        # A restore means a previous server life ended uncleanly (or at
+        # least left a WAL behind) — worth a line in the anomaly log.
+        obs.default_flight_recorder().note(
+            "wal_restore", "info", version=version, wal_dir=wal_dir,
+        )
     return WalWriter(buffer, wal, every=wal_every)
 
 
@@ -161,6 +197,21 @@ class _SnapshotCache:
             entry = (version, payload)
             self._entries[codec] = entry
             return entry
+
+
+def _dump_flight_on_kill(boot: str, wal_dir: Optional[str]) -> Optional[str]:
+    """Crash-path flight-recorder dump: next to the WAL when there is
+    one (the operator is already looking there after a crash), else the
+    tempdir. Best-effort — a full disk must not mask the kill itself."""
+    recorder = obs.default_flight_recorder()
+    if not recorder.enabled:
+        return None
+    base = wal_dir if wal_dir else tempfile.gettempdir()
+    path = os.path.join(base, f"flight-{boot}.json")
+    try:
+        return recorder.dump(path)
+    except OSError:
+        return None
 
 
 def _default_bind_host() -> str:
@@ -234,7 +285,50 @@ class _BarrierBook:
             return self._counts.get(tag, 0)
 
 
-class HttpServer(BaseParameterServer):
+class _ObservableServerMixin:
+    """Shared observability plumbing for the wire servers: per-request
+    tracer resolution, opsd mounting, and the crash-path flight dump.
+
+    Expects the host class to set ``tracer`` (override or None),
+    ``ops_port``, ``ops``, ``flight_dump``, ``_wal_dir``, ``buffer``,
+    ``detector``, ``boot``, ``host``, ``port``.
+    """
+
+    def _tracer(self):
+        # Resolved per use: an enable_tracing() after start() is seen.
+        return self.tracer if self.tracer is not None else obs.default_tracer()
+
+    def _mount_ops(self, transport: str) -> None:
+        if self.ops_port is None:
+            return
+        from elephas_tpu.obs.opsd import OpsServer
+
+        buffer, detector, boot = self.buffer, self.detector, self.boot
+        self.ops = OpsServer(
+            port=self.ops_port,
+            tracer=self.tracer,  # None → live process default
+            vars_fn=lambda: {"boot": boot, "version": buffer.version,
+                             "transport": transport,
+                             "ps_host": self.host, "ps_port": self.port},
+            health_fn=lambda: {"membership": detector.membership()},
+        ).start()
+
+    def _unmount_ops(self) -> None:
+        if self.ops is not None:
+            self.ops.stop()
+            self.ops = None
+
+    def _record_kill(self) -> None:
+        """Flight-record the crash and dump the ring to disk — BEFORE
+        connections are severed, so the artifact exists even though the
+        'process' skips every clean-shutdown sync."""
+        obs.default_flight_recorder().note(
+            "ps_kill", "error", boot=self.boot, version=self.buffer.version,
+        )
+        self.flight_dump = _dump_flight_on_kill(self.boot, self._wal_dir)
+
+
+class HttpServer(_ObservableServerMixin, BaseParameterServer):
     """HTTP transport over a ParameterBuffer (reference ``HttpServer``).
 
     Protocol parity: ``GET /parameters`` returns pickled weights,
@@ -255,6 +349,8 @@ class HttpServer(BaseParameterServer):
         wal_dir: Optional[str] = None,
         wal_every: int = 1,
         heartbeat_timeout: Optional[float] = None,
+        tracer=None,
+        ops_port: Optional[int] = None,
     ):
         """``auth_key``: shared HMAC-SHA256 secret. When set, every
         request must carry ``X-Elephas-Auth`` = hexmac(method + path +
@@ -272,7 +368,17 @@ class HttpServer(BaseParameterServer):
         snapshot (cold start when empty) and every accepted push is made
         durable BEFORE it is acked, at most ``wal_every`` versions behind.
         ``heartbeat_timeout``: failure-detector suspect threshold
-        (default ``ELEPHAS_HEARTBEAT_TIMEOUT`` or 5s; dead at 2x)."""
+        (default ``ELEPHAS_HEARTBEAT_TIMEOUT`` or 5s; dead at 2x).
+
+        ``tracer``: span recorder for server-side handle spans (default:
+        the process-global tracer, resolved per request so a later
+        ``enable_tracing()`` is picked up). Handle spans adopt the
+        client's wire-propagated trace context and are tagged with this
+        server's boot id — across a kill/warm-restart the trace id
+        stays the client's while the boot id changes.
+        ``ops_port``: mount an ``obs.opsd.OpsServer`` (loopback by
+        default) on this port at ``start()`` — 0 picks a free port
+        (read ``.ops.port``)."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
@@ -285,6 +391,11 @@ class HttpServer(BaseParameterServer):
         self.wal_writer = (
             _attach_wal(self.buffer, wal_dir, wal_every) if wal_dir else None
         )
+        self.tracer = tracer
+        self.ops_port = ops_port
+        self.ops = None
+        self.flight_dump: Optional[str] = None
+        self._wal_dir = wal_dir
         self._httpd = None
         self._thread = None
 
@@ -297,7 +408,8 @@ class HttpServer(BaseParameterServer):
         detector = self.detector
         wal_writer = self.wal_writer
         cache = self._cache = _SnapshotCache(buffer, boot=boot)
-        cache_hits, bytes_tx, bytes_rx = _ps_counters()
+        cache_hits, bytes_tx, bytes_rx = _ps_counters("http")
+        tracer_of = self._tracer
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # silence per-request stderr spam
@@ -366,28 +478,40 @@ class HttpServer(BaseParameterServer):
                 if not self._authed():
                     return
                 if path == "/parameters":
-                    # Codec negotiation: packed-aware clients say so; the
-                    # default stays pickle for legacy peers. The encoded
-                    # snapshot comes from the version-gated cache — the
-                    # buffer lock is never held across serialization.
-                    codec = "packed" if self.headers.get(
-                        "X-Elephas-Codec") == "packed" else "pickle"
-                    known = self.headers.get("X-Elephas-Version")
-                    known_boot = self.headers.get("X-Elephas-Boot")
-                    version, payload = cache.frames(codec)
-                    # Not-modified requires the BOOT to match too: after a
-                    # warm restart the version counter resumes an old
-                    # line, so a bare version match could alias content
-                    # from a previous server life (see _new_boot_id).
-                    if codec == "packed" and known is not None \
-                            and known == str(version) and known_boot == boot:
-                        payload = wire.encode_not_modified(version)
-                        cache_hits.inc()
-                    bytes_tx.inc(payload.nbytes if isinstance(
-                        payload, socket_utils.RawPayload) else len(payload))
-                    self._reply(payload,
-                                content_type="application/octet-stream",
-                                version=version)
+                    # Adopt the client's wire-propagated trace context:
+                    # this handle span becomes the remote child of the
+                    # client's ps/pull span in the merged trace, tagged
+                    # with THIS boot id (a warm restart keeps the trace
+                    # id, changes the boot).
+                    ctx = _parse_trace_header(
+                        self.headers.get("X-Elephas-Trace"))
+                    with obs.activate(ctx), tracer_of().span(
+                            "ps/handle_pull", boot=boot, transport="http"):
+                        # Codec negotiation: packed-aware clients say so;
+                        # the default stays pickle for legacy peers. The
+                        # encoded snapshot comes from the version-gated
+                        # cache — the buffer lock is never held across
+                        # serialization.
+                        codec = "packed" if self.headers.get(
+                            "X-Elephas-Codec") == "packed" else "pickle"
+                        known = self.headers.get("X-Elephas-Version")
+                        known_boot = self.headers.get("X-Elephas-Boot")
+                        version, payload = cache.frames(codec)
+                        # Not-modified requires the BOOT to match too:
+                        # after a warm restart the version counter resumes
+                        # an old line, so a bare version match could alias
+                        # content from a previous server life
+                        # (see _new_boot_id).
+                        if codec == "packed" and known is not None \
+                                and known == str(version) \
+                                and known_boot == boot:
+                            payload = wire.encode_not_modified(version)
+                            cache_hits.inc()
+                        bytes_tx.inc(payload.nbytes if isinstance(
+                            payload, socket_utils.RawPayload) else len(payload))
+                        self._reply(payload,
+                                    content_type="application/octet-stream",
+                                    version=version)
                 elif path == "/membership":
                     self._reply(json.dumps(detector.membership()).encode(),
                                 content_type="application/json")
@@ -408,12 +532,27 @@ class HttpServer(BaseParameterServer):
                     # body self-describes (packed magic vs pickle), so
                     # one endpoint serves both codecs' pushes.
                     bytes_rx.inc(len(body))
-                    buffer.apply_delta(wire.decode_payload(body))
-                    if wal_writer is not None:
-                        # Durability BEFORE the ack: once the worker sees
-                        # this reply, the delta survives a PS crash (at
-                        # most wal_every-1 trailing versions are at risk).
-                        wal_writer.after_update()
+                    # Trace context: the HTTP header, or (packed bodies)
+                    # the frame's own "tc" header. Decoding is zero-copy,
+                    # so doing it before the handle span costs ~nothing.
+                    tree, body_tc = wire.decode_payload_traced(body)
+                    ctx = (_parse_trace_header(
+                               self.headers.get("X-Elephas-Trace"))
+                           or _as_trace_ctx(body_tc))
+                    tracer = tracer_of()
+                    with obs.activate(ctx), tracer.span(
+                            "ps/handle_push", boot=boot, transport="http"):
+                        with tracer.span("ps/apply", boot=boot):
+                            # The buffer-lock + apply + WAL durability
+                            # window — the "lock" phase in the per-unit
+                            # critical-path table.
+                            buffer.apply_delta(tree)
+                            if wal_writer is not None:
+                                # Durability BEFORE the ack: once the
+                                # worker sees this reply, the delta
+                                # survives a PS crash (at most
+                                # wal_every-1 trailing versions at risk).
+                                wal_writer.after_update()
                     self._reply(b"")
                 elif path.startswith("/heartbeat/"):
                     detector.beat(path[len("/heartbeat/"):])
@@ -431,8 +570,10 @@ class HttpServer(BaseParameterServer):
             self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        self._mount_ops("http")
 
     def stop(self) -> None:
+        self._unmount_ops()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -444,8 +585,13 @@ class HttpServer(BaseParameterServer):
         """Simulate a crash: stop accepting, sever in-flight connections,
         and — unlike ``stop`` — do NOT sync the WAL. What survives is
         exactly what ``after_update`` already made durable, which is the
-        contract chaos tests exercise."""
+        contract chaos tests exercise. The flight recorder IS dumped
+        (``flight_dump``): a real crash handler would do the same from a
+        signal/atexit hook, and the post-mortem needs the anomaly ring
+        precisely when the shutdown was unclean."""
         if self._httpd is not None:
+            self._record_kill()
+            self._unmount_ops()
             self._httpd.shutdown()
             self._httpd.sever_all()
             self._httpd.server_close()
@@ -476,7 +622,8 @@ class _SocketHandler(socketserver.BaseRequestHandler):
         boot = self.server.boot  # type: ignore[attr-defined]
         detector = self.server.detector  # type: ignore[attr-defined]
         wal_writer = self.server.wal_writer  # type: ignore[attr-defined]
-        cache_hits, bytes_tx, bytes_rx = _ps_counters()
+        tracer_of = self.server.tracer_of  # type: ignore[attr-defined]
+        cache_hits, bytes_tx, bytes_rx = _ps_counters("socket")
         try:
             while True:
                 # With auth_key set, receive() verifies the frame's HMAC
@@ -498,20 +645,34 @@ class _SocketHandler(socketserver.BaseRequestHandler):
 
                 # A raw (non-pickled) payload is a packed-codec PUSH:
                 # the frame body IS the delta, sent without a pickle
-                # wrapper so the server decodes it zero-copy.
+                # wrapper so the server decodes it zero-copy. The frame's
+                # own "tc" header carries the pusher's trace context —
+                # adopt it so the handle/apply spans join the worker's
+                # unit trace across the socket.
                 if isinstance(obj, (bytes, bytearray, memoryview)):
                     mv = memoryview(obj)
                     bytes_rx.inc(mv.nbytes)
-                    buffer.apply_delta(wire.decode_payload(mv))
-                    if wal_writer is not None:
-                        wal_writer.after_update()  # durable before the ack
+                    tree, tc = wire.decode_payload_traced(mv)
+                    tracer = tracer_of()
+                    with obs.activate(_as_trace_ctx(tc)), tracer.span(
+                            "ps/handle_push", boot=boot, transport="socket"):
+                        with tracer.span("ps/apply", boot=boot):
+                            buffer.apply_delta(tree)
+                            if wal_writer is not None:
+                                wal_writer.after_update()  # durable pre-ack
                     reply(b"ok")
                     continue
 
-                kind, payload = obj
+                # Frames are (kind, payload) from legacy peers or
+                # (kind, payload, trace_ctx) from tracing ones — the
+                # optional third element never changes dispatch.
+                kind, payload, *rest = obj
+                ctx = _as_trace_ctx(rest[0]) if rest else None
                 if kind == "g":  # legacy pull → cached pickle snapshot
-                    _, snap = cache.frames("pickle")
-                    reply(socket_utils.RawPayload([snap]))
+                    with obs.activate(ctx), tracer_of().span(
+                            "ps/handle_pull", boot=boot, transport="socket"):
+                        _, snap = cache.frames("pickle")
+                        reply(socket_utils.RawPayload([snap]))
                 elif kind == "G":
                     # Packed pull; payload is the client's last-seen
                     # position — ``(boot, version)`` from resilient
@@ -519,17 +680,25 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                     # version can alias a previous server life after warm
                     # restart, so it NEVER earns a not-modified reply
                     # (full body instead — correct, just uncached).
-                    version, frames = cache.frames("packed")
-                    if (isinstance(payload, (tuple, list)) and len(payload) == 2
-                            and payload[0] == boot and payload[1] == version):
-                        cache_hits.inc()
-                        reply(wire.encode_not_modified(version))
-                    else:
-                        reply(frames)
+                    with obs.activate(ctx), tracer_of().span(
+                            "ps/handle_pull", boot=boot, transport="socket"):
+                        version, frames = cache.frames("packed")
+                        if (isinstance(payload, (tuple, list))
+                                and len(payload) == 2
+                                and payload[0] == boot
+                                and payload[1] == version):
+                            cache_hits.inc()
+                            reply(wire.encode_not_modified(version))
+                        else:
+                            reply(frames)
                 elif kind == "u":
-                    buffer.apply_delta(payload)
-                    if wal_writer is not None:
-                        wal_writer.after_update()  # durable before the ack
+                    tracer = tracer_of()
+                    with obs.activate(ctx), tracer.span(
+                            "ps/handle_push", boot=boot, transport="socket"):
+                        with tracer.span("ps/apply", boot=boot):
+                            buffer.apply_delta(payload)
+                            if wal_writer is not None:
+                                wal_writer.after_update()  # durable pre-ack
                     reply(b"ok")
                 elif kind == "h":  # heartbeat: payload = worker id
                     detector.beat(str(payload))
@@ -599,7 +768,7 @@ class _ThreadingTCPServer(_ConnectionTracker, socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-class SocketServer(BaseParameterServer):
+class SocketServer(_ObservableServerMixin, BaseParameterServer):
     """Raw-TCP transport (reference ``SocketServer``): persistent
     connections carrying ``('g', None)`` / ``('u', delta)`` frames."""
 
@@ -615,13 +784,16 @@ class SocketServer(BaseParameterServer):
         wal_dir: Optional[str] = None,
         wal_every: int = 1,
         heartbeat_timeout: Optional[float] = None,
+        tracer=None,
+        ops_port: Optional[int] = None,
     ):
         """``auth_key``: shared HMAC-SHA256 secret — every frame in both
         directions carries a tag (nonce+timestamp under the MAC) verified
         before unpickling, and the server rejects replayed/stale nonces
         (see ``utils.sockets.send/receive``/``ReplayGuard``).
-        ``wal_dir``/``wal_every``/``heartbeat_timeout``: see
-        ``HttpServer`` — identical durability and liveness semantics."""
+        ``wal_dir``/``wal_every``/``heartbeat_timeout``/``tracer``/
+        ``ops_port``: see ``HttpServer`` — identical durability,
+        liveness, and observability semantics."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
@@ -634,6 +806,11 @@ class SocketServer(BaseParameterServer):
         self.wal_writer = (
             _attach_wal(self.buffer, wal_dir, wal_every) if wal_dir else None
         )
+        self.tracer = tracer
+        self.ops_port = ops_port
+        self.ops = None
+        self.flight_dump: Optional[str] = None
+        self._wal_dir = wal_dir
         self._server = None
         self._thread = None
 
@@ -647,12 +824,15 @@ class SocketServer(BaseParameterServer):
         self._server.boot = self.boot  # type: ignore[attr-defined]
         self._server.detector = self.detector  # type: ignore[attr-defined]
         self._server.wal_writer = self.wal_writer  # type: ignore[attr-defined]
+        self._server.tracer_of = self._tracer  # type: ignore[attr-defined]
         if self.port == 0:
             self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
+        self._mount_ops("socket")
 
     def stop(self) -> None:
+        self._unmount_ops()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -664,8 +844,12 @@ class SocketServer(BaseParameterServer):
         """Simulate a crash: sever live connections (persistent socket
         clients would otherwise keep being served by their handler
         threads) and skip the clean-shutdown WAL sync — durability after
-        a kill is exactly what ``after_update`` already flushed."""
+        a kill is exactly what ``after_update`` already flushed. The
+        flight recorder IS dumped first (``flight_dump``) — the
+        post-mortem artifact a real crash handler would emit."""
         if self._server is not None:
+            self._record_kill()
+            self._unmount_ops()
             self._server.shutdown()
             self._server.sever_all()
             self._server.server_close()
@@ -694,6 +878,8 @@ def make_server(
     wal_dir: Optional[str] = None,
     wal_every: int = 1,
     heartbeat_timeout: Optional[float] = None,
+    tracer=None,
+    ops_port: Optional[int] = None,
 ) -> BaseParameterServer:
     """Factory keyed on the reference's ``parameter_server_mode``.
     ``granularity`` ('tree'|'leaf') sets the hogwild apply isolation —
@@ -702,7 +888,10 @@ def make_server(
     ``wal_dir``/``wal_every`` make accepted pushes durable and enable
     warm restart (wire transports only — a local server shares the
     workers' process, so any crash that needs the WAL also killed the
-    training job the WAL would resume into)."""
+    training job the WAL would resume into). ``tracer``/``ops_port``:
+    server-side handle spans and the mountable ops endpoint (wire
+    transports; the local server shares the workers' process-global
+    tracer already)."""
     if mode == "local":
         if wal_dir is not None:
             raise ValueError(
@@ -716,10 +905,12 @@ def make_server(
         return HttpServer(params, lock=lock, port=port, device=device, host=host,
                           granularity=granularity, auth_key=auth_key,
                           wal_dir=wal_dir, wal_every=wal_every,
-                          heartbeat_timeout=heartbeat_timeout)
+                          heartbeat_timeout=heartbeat_timeout,
+                          tracer=tracer, ops_port=ops_port)
     if mode == "socket":
         return SocketServer(params, lock=lock, port=port, device=device, host=host,
                             granularity=granularity, auth_key=auth_key,
                             wal_dir=wal_dir, wal_every=wal_every,
-                            heartbeat_timeout=heartbeat_timeout)
+                            heartbeat_timeout=heartbeat_timeout,
+                            tracer=tracer, ops_port=ops_port)
     raise ValueError(f"parameter_server_mode must be local|http|socket, got {mode!r}")

@@ -60,6 +60,7 @@ __all__ = [
     "WireFormatError",
     "decode",
     "decode_payload",
+    "decode_payload_traced",
     "decode_pickle",
     "encode_not_modified",
     "encode_pickle",
@@ -118,14 +119,20 @@ class DecodedTree:
     boot id per process start, and version-gated pulls must match
     (boot, version) — a server warm-restarted from a WAL snapshot resumes
     an OLD version counter, so version alone could collide with a
-    client's cache and yield a stale not-modified."""
+    client's cache and yield a stale not-modified.
 
-    __slots__ = ("tree", "version", "boot")
+    ``trace`` (observability layer): the sender's active
+    ``(trace_id, span_id)`` pair, when it shipped one — the PS handler
+    adopts it so its handle span joins the client's causal tree."""
 
-    def __init__(self, tree, version: Optional[int], boot: Optional[str] = None):
+    __slots__ = ("tree", "version", "boot", "trace")
+
+    def __init__(self, tree, version: Optional[int], boot: Optional[str] = None,
+                 trace: Optional[Tuple[str, str]] = None):
         self.tree = tree
         self.version = version
         self.boot = boot
+        self.trace = trace
 
 
 def is_packed(buf) -> bool:
@@ -226,7 +233,8 @@ def _leaf_chunk(arr: np.ndarray):
 
 def encode_tree(tree, version: Optional[int] = None,
                 quantize: Optional[str] = None,
-                boot: Optional[str] = None) -> Frames:
+                boot: Optional[str] = None,
+                trace: Optional[Tuple[str, str]] = None) -> Frames:
     """Encode a pytree of arrays/scalars into a packed frame.
 
     ``boot``: the serving PS's boot id, carried in the header so clients
@@ -235,6 +243,11 @@ def encode_tree(tree, version: Optional[int] = None,
     pre-resilience peers. Raises ``WireFormatError`` for structures the
     skeleton can't carry (non-JSON dict keys, custom container nodes) —
     callers fall back to ``encode_pickle``.
+
+    ``trace``: the sender's active ``(trace_id, span_id)`` — carried as
+    ``"tc"`` in the header so the receiving PS's handle span joins the
+    sender's trace. Like ``boot``, omitted entirely when None: frames
+    from untraced processes stay byte-identical with older peers.
     """
     leaves: List[Any] = []
     skeleton = _build_skeleton(tree, leaves)
@@ -263,6 +276,8 @@ def encode_tree(tree, version: Optional[int] = None,
                             "leaves": rows}
     if boot is not None:
         meta["boot"] = str(boot)
+    if trace is not None:
+        meta["tc"] = [str(trace[0]), str(trace[1])]
     header = json.dumps(meta, separators=(",", ":")).encode()
     # Pad the header with spaces (JSON-transparent) so the payload
     # region starts 64B-aligned relative to the frame start.
@@ -354,7 +369,9 @@ def decode(buf, expect_treedef=None):
                 f"packed frame treedef mismatch: got {got}, expected "
                 f"{expect_treedef}"
             )
-    return DecodedTree(tree, header.get("ver"), header.get("boot"))
+    tc = header.get("tc")
+    return DecodedTree(tree, header.get("ver"), header.get("boot"),
+                       tuple(tc) if tc else None)
 
 
 def decode_payload(buf, expect_treedef=None):
@@ -371,3 +388,17 @@ def decode_payload(buf, expect_treedef=None):
             raise WireFormatError("not-modified frame where a tree was expected")
         return out.tree
     return decode_pickle(buf)
+
+
+def decode_payload_traced(buf, expect_treedef=None):
+    """``decode_payload`` that also surfaces the sender's trace context:
+    ``(tree, (trace_id, span_id) | None)``. The PS push handlers use
+    this so ``buffer.apply_delta`` runs under the pushing worker's
+    trace; legacy pickle bodies carry no context (the pickle *frame*
+    does, upstream, via the 3-tuple socket shape)."""
+    if is_packed(buf):
+        out = decode(buf, expect_treedef=expect_treedef)
+        if isinstance(out, NotModified):
+            raise WireFormatError("not-modified frame where a tree was expected")
+        return out.tree, out.trace
+    return decode_pickle(buf), None

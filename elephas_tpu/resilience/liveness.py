@@ -87,9 +87,23 @@ class FailureDetector:
         """Record one heartbeat; a beat from a dead worker revives it."""
         worker_id = str(worker_id)
         with self._lock:
+            revived = worker_id in self._dead
+            dead_for = (
+                self._clock() - self._last_beat[worker_id]
+                if revived and worker_id in self._last_beat else None
+            )
             self._last_beat[worker_id] = self._clock()
             self._beats[worker_id] = self._beats.get(worker_id, 0) + 1
             self._dead.discard(worker_id)
+        if revived:
+            # A dead→alive flap is either a stalled-then-unstuck worker
+            # or a detector threshold set too tight — both worth a
+            # flight-recorder entry (outside the lock: note() takes the
+            # recorder's own lock).
+            obs.default_flight_recorder().note(
+                "heartbeat_flap", "warn", worker=worker_id,
+                dead_for_s=round(dead_for, 3) if dead_for is not None else None,
+            )
 
     def deregister(self, worker_id: str) -> None:
         """Clean exit: the worker leaves WITHOUT counting as an expiry."""
@@ -119,8 +133,13 @@ class FailureDetector:
                 if now - last >= self.dead_after:
                     self._dead.add(worker_id)
                     newly_dead.append(worker_id)
-        if newly_dead and self._expired_total is not None:
-            self._expired_total.inc(len(newly_dead))
+        if newly_dead:
+            if self._expired_total is not None:
+                self._expired_total.inc(len(newly_dead))
+            obs.default_flight_recorder().note(
+                "worker_dead", "error", workers=list(newly_dead),
+                dead_after_s=self.dead_after,
+            )
         return newly_dead
 
     def membership(self) -> Dict[str, Dict]:

@@ -178,6 +178,7 @@ class InferenceEngine:
         self._results: Dict[int, GenerationResult] = {}
         self._cond = threading.Condition()
         self._step_lock = threading.Lock()
+        self.ops = None  # OpsServer, mounted on demand
 
     def _make_jits(self, in_shardings=None, out_shardings=None):
         """(Re)build the two compiled entry points. With shardings the
@@ -378,8 +379,12 @@ class InferenceEngine:
         )
         try:
             self.queue.submit(req)
-        except QueueFull:
+        except QueueFull as err:
             self.metrics.record_reject()
+            obs.default_flight_recorder().note(
+                "backpressure_reject", "warn", req_id=req.req_id,
+                queue_depth=len(self.queue), retry_after_s=err.retry_after,
+            )
             raise
         self.metrics.record_submit()
         self.tracer.instant(
@@ -475,6 +480,36 @@ class InferenceEngine:
             "pool_active": self.pool.active_count,
             "pool_free": self.pool.free_count,
         }
+
+    def mount_ops(self, port: int = 0, host: Optional[str] = None):
+        """Mount a live introspection endpoint (``obs.opsd``) for this
+        engine: ``/metrics``, ``/healthz`` (+ queue/pool summary),
+        ``/trace``, ``/vars``, ``/flight``. Loopback-bound by default;
+        port 0 picks a free one (read ``engine.ops.port``). Idempotent.
+        """
+        if self.ops is not None:
+            return self.ops
+        from elephas_tpu.obs.opsd import OpsServer
+
+        self.ops = OpsServer(
+            port=port, host=host, tracer=self.tracer,
+            vars_fn=lambda: {
+                "role": "serving",
+                "max_slots": self.pool.max_slots,
+                "max_prompt_len": self.max_prompt_len,
+            },
+            health_fn=lambda: {
+                "queue_depth": len(self.queue),
+                "pool_active": self.pool.active_count,
+                "pool_free": self.pool.free_count,
+            },
+        ).start()
+        return self.ops
+
+    def unmount_ops(self) -> None:
+        if self.ops is not None:
+            self.ops.stop()
+            self.ops = None
 
 
 def shard_serving(engine: InferenceEngine, mesh, rules=None) -> InferenceEngine:

@@ -264,7 +264,12 @@ class ContinuousBatchingScheduler:
             s for s, e in self._active.items()
             if e.request.deadline is not None and now >= e.request.deadline
         ]:
-            self._finish(self._active[slot], "timeout")
+            entry = self._active[slot]
+            obs.default_flight_recorder().note(
+                "deadline_eviction", "warn", req_id=entry.request.req_id,
+                where="decode", tokens=len(entry.tokens),
+            )
+            self._finish(entry, "timeout")
 
     def _admit_from_queue(self) -> None:
         import jax.numpy as jnp
@@ -278,6 +283,10 @@ class ContinuousBatchingScheduler:
             # A request can expire while still queued — don't burn a
             # prefill on it.
             if req.deadline is not None and t_pop >= req.deadline:
+                obs.default_flight_recorder().note(
+                    "deadline_eviction", "warn", req_id=req.req_id,
+                    where="queue", tokens=0,
+                )
                 self.tracer.record(
                     "queue", req.submitted_at, t_pop, track=track,
                     req_id=req.req_id,

@@ -24,35 +24,57 @@ unset or ``0`` compiles with backend defaults.
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
-from typing import Optional
+import time
+from typing import Dict, Optional
 
 import jax
 
 logger = logging.getLogger("elephas_tpu")
 
+# Retrace-storm detection: a hot program retracing this many times
+# inside the window is no longer "a shape changed once" — something is
+# feeding it fresh shapes/dtypes per call and silently recompiling on
+# the hot path. The 4th retrace in 60s files a flight-recorder anomaly.
+_RETRACE_STORM_COUNT = 4
+_RETRACE_STORM_WINDOW_S = 60.0
+_retrace_times: Dict[str, collections.deque] = {}
+
 
 def note_retrace(program: str, **args) -> None:
     """Record a (re)trace of a hot program on the global observability
-    layer: a ``retrace_total`` counter bump (plus a per-program counter)
-    and an instant ``compile/<program>`` event on the default tracer.
+    layer: a ``retrace_total{program=...}`` counter bump and an instant
+    ``compile/<program>`` event on the default tracer.
 
     Call this from inside a jitted function's Python body — the body
     only runs when XLA (re)traces it, so a surprise retrace (a silent
     10× regression when it happens per step) becomes a visible counter
     and a trace marker instead of nothing. The serving engine wires its
     prefill/decode bodies through here; tests pin those at one trace
-    each.
+    each. Repeated retraces of one program inside a short window are a
+    *retrace storm* and additionally land in the flight recorder.
     """
     from elephas_tpu import obs
 
-    registry = obs.default_registry()
-    registry.counter(
-        "retrace_total", help="hot-program (re)traces across the process"
-    ).inc()
-    registry.counter(f"retrace_total::{program}").inc()
+    obs.default_registry().counter(
+        "retrace_total",
+        help="hot-program (re)traces across the process",
+        labelnames=("program",),
+    ).labels(program=program).inc()
     obs.default_tracer().instant(f"compile/{program}", **args)
+    now = time.monotonic()
+    times = _retrace_times.setdefault(
+        program, collections.deque(maxlen=_RETRACE_STORM_COUNT))
+    times.append(now)
+    if (len(times) == _RETRACE_STORM_COUNT
+            and now - times[0] <= _RETRACE_STORM_WINDOW_S):
+        obs.default_flight_recorder().note(
+            "retrace_storm", "warn", program=program,
+            retraces=_RETRACE_STORM_COUNT,
+            window_s=round(now - times[0], 3),
+        )
     logger.debug("retrace: %s %s", program, args or "")
 
 

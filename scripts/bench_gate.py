@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Bench gate: diff a fresh bench run against the committed artifact.
+
+The repo commits its measured baselines (``BENCH_SERVE.json``,
+``BENCH_PS.json``, ``BENCH_CHAOS.json``); a perf regression today is
+only caught by a human re-reading numbers. This gate makes the diff
+mechanical: re-run the bench, hand both files to ``bench_gate.py``, and
+get a machine-readable verdict — one check per (row, metric) with the
+threshold that was applied, and a process exit code CI can gate on.
+
+Matching: rows are joined on an artifact-specific identity key (serving
+rows on ``(mode, pipeline)``, PS rows on ``(mode, codec, op, quantize,
+pipelined)``, chaos rows on ``scenario``) — never on position, so
+re-ordered or appended rows don't misalign the diff. A baseline row
+missing from the fresh run fails; extra fresh rows are ignored (a new
+bench mode is not a regression).
+
+Thresholds are per-metric and directional, deliberately loose: bench
+numbers come from shared CI machines, so the gate is tuned to catch
+step-change regressions (a 2× transport slowdown, a broken cache, a
+serving-overhead blowout past its guardrail), not 5% noise. Throughput
+(«higher») metrics may drop to ``1 - rel`` of baseline; latency
+(«lower») metrics may grow to ``1 + rel``; ``equal`` metrics (unit
+accounting, completion flags) must match exactly; ``limit`` metrics are
+absolute ceilings independent of the baseline (the serving trace
+overhead guardrail stays < 2% no matter what it measured last time).
+
+Usage:
+    python scripts/bench_gate.py --serve BENCH_SERVE.json fresh.json \
+        --ps BENCH_PS.json fresh_ps.jsonl \
+        --chaos BENCH_CHAOS.json fresh_chaos.jsonl \
+        [--out VERDICT.json]
+
+Importable: ``compare(baseline_rows, fresh_rows, kind) -> list[check]``
+and ``gate(pairs) -> verdict`` are pure — tests feed them literal rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# -- per-artifact rules ------------------------------------------------------
+
+# Each kind: (key_fields, [(metric, direction, tolerance)]).
+# direction: "higher" — fresh >= base*(1-tol); "lower" — fresh <=
+# base*(1+tol); "equal" — exact match; "limit" — fresh <= tol (absolute,
+# baseline ignored).
+RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
+    "serve": (
+        ("mode", "pipeline"),
+        [
+            ("tokens_per_sec", "higher", 0.35),
+            ("ttft_s_p95", "lower", 0.60),
+            ("itl_s_p95", "lower", 0.60),
+            ("all_completed", "equal", 0.0),
+            # The serving trace-overhead guardrail is an absolute
+            # ceiling: tracing must stay under 2% regardless of what
+            # the committed baseline happened to measure.
+            ("overhead_pct", "limit", 2.0),
+        ],
+    ),
+    "ps": (
+        ("mode", "codec", "op", "quantize", "pipelined"),
+        [
+            ("mb_per_s", "higher", 0.50),
+            ("secs_per_roundtrip", "lower", 0.75),
+            ("secs_per_unit", "lower", 0.75),
+            ("speedup", "higher", 0.50),
+            ("ratio", "higher", 0.50),
+        ],
+    ),
+    "chaos": (
+        ("scenario",),
+        [
+            ("completed_units", "equal", 0.0),
+            ("wall_s", "lower", 1.00),
+            ("mttr_max_s", "lower", 1.00),
+            ("final_loss", "lower", 1.00),
+        ],
+    ),
+}
+
+
+def load_rows(path: str) -> List[dict]:
+    """Either a JSON array or JSONL — both artifact shapes exist."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text:
+        return []
+    if text[0] == "[":
+        return json.loads(text)
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def _row_key(row: dict, fields: Tuple[str, ...]) -> Tuple:
+    return tuple(str(row.get(f)) for f in fields)
+
+
+def _check(metric: str, direction: str, tol: float,
+           base, fresh) -> Tuple[bool, str]:
+    if direction == "equal":
+        return fresh == base, f"must equal {base!r}"
+    if direction == "limit":
+        return float(fresh) <= tol, f"must be <= {tol}"
+    if direction == "higher":
+        floor = float(base) * (1.0 - tol)
+        return float(fresh) >= floor, f"must be >= {floor:.6g}"
+    if direction == "lower":
+        ceil = float(base) * (1.0 + tol)
+        return float(fresh) <= ceil, f"must be <= {ceil:.6g}"
+    raise ValueError(f"unknown direction {direction!r}")
+
+
+def compare(baseline_rows: List[dict], fresh_rows: List[dict],
+            kind: str) -> List[dict]:
+    """Pure diff: one check dict per (baseline row, applicable metric).
+
+    A check is ``{"kind", "key", "metric", "baseline", "fresh",
+    "threshold", "ok"}``; a baseline row absent from the fresh run
+    yields a single failing ``row_present`` check. Metrics absent from
+    a baseline row don't apply to it (the rule table is a superset over
+    all row shapes of the artifact).
+    """
+    key_fields, metric_rules = RULES[kind]
+    fresh_by_key = {_row_key(r, key_fields): r for r in fresh_rows}
+    checks: List[dict] = []
+    for base_row in baseline_rows:
+        key = _row_key(base_row, key_fields)
+        applicable = [
+            (m, d, t) for m, d, t in metric_rules
+            if m in base_row and base_row[m] is not None
+        ]
+        if not applicable:
+            continue  # meta rows carry config, not gated metrics
+        fresh_row = fresh_by_key.get(key)
+        label = "/".join(k for k in key if k != "None")
+        if fresh_row is None:
+            checks.append({
+                "kind": kind, "key": label, "metric": "row_present",
+                "baseline": True, "fresh": False,
+                "threshold": "row must exist in fresh run", "ok": False,
+            })
+            continue
+        for metric, direction, tol in applicable:
+            fresh_val = fresh_row.get(metric)
+            if fresh_val is None:
+                ok, desc = False, "metric missing from fresh run"
+            else:
+                ok, desc = _check(metric, direction, tol,
+                                  base_row[metric], fresh_val)
+            checks.append({
+                "kind": kind, "key": label, "metric": metric,
+                "baseline": base_row[metric], "fresh": fresh_val,
+                "threshold": desc, "ok": ok,
+            })
+    return checks
+
+
+def gate(pairs: Dict[str, Tuple[List[dict], List[dict]]]) -> dict:
+    """Run ``compare`` per artifact kind; roll up a machine-readable
+    verdict: ``{"verdict": "pass"|"fail", "checks": N, "failures":
+    [...failing checks...], "by_kind": {kind: {checks, failures}}}``."""
+    all_checks: List[dict] = []
+    by_kind = {}
+    for kind, (baseline_rows, fresh_rows) in pairs.items():
+        checks = compare(baseline_rows, fresh_rows, kind)
+        by_kind[kind] = {
+            "checks": len(checks),
+            "failures": sum(1 for c in checks if not c["ok"]),
+        }
+        all_checks.extend(checks)
+    failures = [c for c in all_checks if not c["ok"]]
+    return {
+        "verdict": "fail" if failures else "pass",
+        "checks": len(all_checks),
+        "failures": failures,
+        "by_kind": by_kind,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> dict:
+    ap = argparse.ArgumentParser(
+        description="Gate fresh bench output against committed baselines"
+    )
+    for kind in RULES:
+        ap.add_argument(
+            f"--{kind}", nargs=2, metavar=("BASELINE", "FRESH"),
+            default=None, help=f"{kind} artifact pair to diff",
+        )
+    ap.add_argument("--out", default=None,
+                    help="write the verdict JSON here too")
+    args = ap.parse_args(argv)
+    pairs = {}
+    for kind in RULES:
+        pair = getattr(args, kind)
+        if pair is not None:
+            pairs[kind] = (load_rows(pair[0]), load_rows(pair[1]))
+    if not pairs:
+        ap.error("give at least one of --serve/--ps/--chaos")
+    verdict = gate(pairs)
+    text = json.dumps(verdict, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if verdict["verdict"] != "pass":
+        sys.exit(1)
+    return verdict
+
+
+if __name__ == "__main__":
+    main()

@@ -29,10 +29,21 @@ failed round trip to the first successful one after recovery — it
 includes the bench's own outage hold-down, the client retry backoff,
 and reconnect cost, which is the number an operator actually sees.
 
+``--trace`` runs the whole bench under the obs tracer and emits the
+distributed-trace artifacts: the in-process ring is split into
+per-role dumps (``chaos_trace_worker.json`` — trainer lanes, client
+``ps/pull``/``ps/push``, comms queue waits — and ``chaos_trace_ps.json``
+— the PS-side ``ps/handle_*``/``ps/apply`` spans, exactly what a remote
+PS's ``/trace`` route would have served), then merges them through
+``scripts/trace_report.py --merge`` into ``chaos_trace_merged.json``
+and prints the per-unit queue/wire/lock/train critical-path table.
+Because the wire codec propagates ``(trace_id, span_id)``, the worker
+and PS dumps join on trace id exactly as true multi-process dumps do.
+
 Importable without a TPU; tier-1-sized defaults finish in ~1 min on
 CPU. Usage:
     python scripts/chaos_bench.py [--epochs 4] [--outage 4.0]
-        [--n 256] [--out BENCH_CHAOS.json]
+        [--n 256] [--out BENCH_CHAOS.json] [--trace] [--trace-dir D]
 """
 
 from __future__ import annotations
@@ -185,6 +196,29 @@ def scenario_partition(x, y, epochs):
                       trace_digest=hex(plan.trace_digest()))
 
 
+def export_role_dumps(tracer, outdir, prefix="chaos_trace"):
+    """Split the in-process span ring into the per-role dumps a real
+    deployment would collect from each process's ``/trace`` route:
+    PS-side handle/apply spans (what the server's opsd serves) vs
+    everything recorded on the trainer side. Both dumps carry clockSync
+    blocks, so the merge exercises the same alignment path as true
+    multi-process dumps. Returns ``(worker_path, ps_path)``."""
+    from elephas_tpu.obs.trace import export_events
+
+    def is_ps(e):
+        return e.name.startswith("ps/handle") or e.name == "ps/apply"
+
+    events = tracer.events()
+    worker_path = os.path.join(outdir, f"{prefix}_worker.json")
+    ps_path = os.path.join(outdir, f"{prefix}_ps.json")
+    export_events([e for e in events if not is_ps(e)], tracer.clock,
+                  path=worker_path, process="worker",
+                  dropped=tracer.dropped)
+    export_events([e for e in events if is_ps(e)], tracer.clock,
+                  path=ps_path, process="ps")
+    return worker_path, ps_path
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--epochs", type=int, default=4)
@@ -193,7 +227,19 @@ def main(argv=None):
                     help="kill_ps hold-down seconds (keep above the "
                          "~2.8s client retry budget so failures surface)")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--trace", action="store_true",
+                    help="record the run under the obs tracer and emit "
+                         "per-role dumps + a merged trace with the "
+                         "per-unit critical-path table")
+    ap.add_argument("--trace-dir", default=".",
+                    help="where --trace writes its three JSON artifacts")
     args = ap.parse_args(argv)
+
+    tracer = None
+    if args.trace:
+        from elephas_tpu import obs
+
+        tracer = obs.enable_tracing(capacity=262144, annotate_device=False)
 
     x, y = make_blobs(args.n)
     rows = [{"scenario": "meta", "epochs": args.epochs, "n": args.n,
@@ -214,6 +260,20 @@ def main(argv=None):
         with open(args.out, "w") as f:
             for row in rows:
                 f.write(json.dumps(row) + "\n")
+
+    if tracer is not None:
+        from elephas_tpu import obs
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import trace_report
+
+        worker_path, ps_path = export_role_dumps(tracer, args.trace_dir)
+        merged_path = os.path.join(args.trace_dir,
+                                   "chaos_trace_merged.json")
+        text = trace_report.merge_report([worker_path, ps_path],
+                                         out=merged_path)
+        print(text, end="")
+        obs.disable_tracing()
     return rows
 
 

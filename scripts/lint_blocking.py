@@ -55,6 +55,20 @@ are flagged anywhere in the resilience package. ``time.monotonic`` /
 injection pattern); only calls are flagged. Escape pragma:
 ``# clock-ok``, for timing provably outside any detector/injector path.
 
+A fifth rule enforces METRIC NAMING across the whole package: the
+registry grew Prometheus label support, so dimensions belong in
+``labelnames=``, never baked into the metric name — and Prometheus
+conventions make the unit part of the name. Any ``.counter("name")``
+call whose literal name doesn't end in ``_total``, any
+``.histogram("name")`` not ending in ``_seconds``, and any f-string
+name on either (an f-string IS a baked dimension — ``retrace_total::
+{program}`` was exactly the shape the label migration removed) is
+flagged. Names that arrive through a variable are not judged — the
+literal lives at its definition site, which is linted there. Gauges
+are unconstrained (no unit convention fits them all). Escape pragma:
+``# metric-ok``, for deliberate deviations (e.g. a bridge exporting a
+foreign system's names verbatim).
+
 Wired into tier-1 via ``tests/test_lint_blocking.py``; also runnable
 standalone: ``python scripts/lint_blocking.py`` (exit 1 on violations).
 """
@@ -71,9 +85,11 @@ SANCTIONED = "host_sync.py"
 PICKLE_PRAGMA = "pickle-ok"
 PICKLE_SANCTIONED = "wire.py"
 CLOCK_PRAGMA = "clock-ok"
+METRIC_PRAGMA = "metric-ok"
 _NUMPY_NAMES = ("np", "numpy")
 _CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
 _PICKLE_ATTRS = ("dumps", "loads", "dump", "load")
+_METRIC_SUFFIX = {"counter": "_total", "histogram": "_seconds"}
 
 
 class Violation(NamedTuple):
@@ -84,6 +100,14 @@ class Violation(NamedTuple):
     domain: str = "serving"
 
     def __str__(self):
+        if self.domain == "metric":
+            return (
+                f"{self.path}:{self.lineno}: metric name {self.call} "
+                f"violates naming (counters end `_total`, histograms end "
+                f"`_seconds`; an f-string name bakes a dimension into it — "
+                f"use labelnames=; `# {METRIC_PRAGMA}` for deliberate "
+                f"foreign names)\n    {self.line.strip()}"
+            )
         if self.domain == "resilience":
             what = "raw sleep" if self.call == "time.sleep" \
                 else "raw clock call"
@@ -250,6 +274,52 @@ def lint_resilience_package(root: Path) -> List[Violation]:
     return out
 
 
+def _metric_call_name(node: ast.Call) -> str | None:
+    """``<anything>.counter("…")`` / ``.histogram("…")`` with a judgeable
+    first argument: a string literal that breaks the suffix convention,
+    or any f-string (a baked dimension). Variable names pass — their
+    literal is linted where it's defined."""
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr in _METRIC_SUFFIX
+            and node.args):
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.JoinedStr):
+        return f"<f-string> in .{fn.attr}()"
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+            and not arg.value.endswith(_METRIC_SUFFIX[fn.attr]):
+        return f"`{arg.value}` in .{fn.attr}()"
+    return None
+
+
+def lint_metric_file(path: Path) -> List[Violation]:
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _metric_call_name(node)
+        if name is None:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if METRIC_PRAGMA in line:
+            continue
+        out.append(Violation(str(path), node.lineno, name, line,
+                             domain="metric"))
+    return out
+
+
+def lint_metric_package(root: Path) -> List[Violation]:
+    """Lint EVERY module of the package tree — metric names are a
+    process-global namespace, so no file is exempt."""
+    out = []
+    for path in sorted(root.rglob("*.py")):
+        out.extend(lint_metric_file(path))
+    return out
+
+
 def main(argv: List[str] | None = None) -> List[Violation]:
     args = list(sys.argv[1:] if argv is None else argv)
     pkg_root = Path(__file__).resolve().parent.parent / "elephas_tpu"
@@ -258,6 +328,7 @@ def main(argv: List[str] | None = None) -> List[Violation]:
     if not args:
         violations.extend(lint_pickle_package(pkg_root / "parameter"))
         violations.extend(lint_resilience_package(pkg_root / "resilience"))
+        violations.extend(lint_metric_package(pkg_root))
     for v in violations:
         print(v)
     if not violations:

@@ -1,8 +1,9 @@
 #!/usr/bin/env python
-"""Per-phase latency report over a Chrome ``trace_event`` JSON file.
+"""Per-phase latency report + multi-process merger over Chrome traces.
 
 Reads the trace the obs tracer exports (``Tracer.export_chrome`` /
-``scripts/lm_bench.py --trace``) back into numbers a human can act on:
+``scripts/lm_bench.py --trace`` / a live ``/trace`` opsd route) back
+into numbers a human can act on:
 
 - a per-phase table — count, p50/p90/p95/p99, mean, total wall — over
   every duration ("X") event name. Percentiles here are EXACT (the file
@@ -12,8 +13,28 @@ Reads the trace the obs tracer exports (``Tracer.export_chrome`` /
   track's events nested by time containment — the submit→queue→admit
   (prefill)→decode→finish lifecycle, as the scheduler recorded it.
 
-Usage: ``python scripts/trace_report.py TRACE.json [--tree-req ID]``
-(importable: ``report(path) -> str`` and ``main(argv)``).
+Merge mode (``--merge DUMP...``) collects per-process dumps — each
+normalized to its own t=0 in its own monotonic clock domain — into ONE
+trace on a shared wall-clock axis: every dump carries a ``clockSync``
+block (``origin_mono_s`` plus a simultaneous (mono, wall) sample taken
+at export), so an event's wall time is
+``wall_at_export - mono_at_export + origin_mono_s + ts``. Each dump
+becomes its own pid row (named via the dump's ``process`` field), and
+because the parameter-server wire codec propagates ``(trace_id,
+span_id)``, a worker's ``ps/push`` and the PS-side ``ps/handle_push``/
+``ps/apply`` spans join on ``args.trace_id`` across the process
+boundary. On top of the join, ``--merge`` prints the per-unit
+critical-path table — queue (comms backlog) vs wire (client round
+trips) vs lock (PS apply under the buffer lock) vs train — with the
+straggler unit first, plus a replay-stable digest over the set of
+completed units (seeded ``FaultPlan`` chaos runs reproduce it).
+
+Usage:
+    python scripts/trace_report.py TRACE.json [--tree-req ID]
+    python scripts/trace_report.py --merge D1.json D2.json...
+        [--out MERGED.json]
+(importable: ``report(path) -> str``, ``merge_dumps``, ``unit_table``,
+``unit_chain_digest``, and ``main(argv)``).
 """
 
 from __future__ import annotations
@@ -21,7 +42,8 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Dict, List, Optional
+import zlib
+from typing import Dict, List, Optional, Union
 
 
 def load_events(path: str) -> List[dict]:
@@ -188,17 +210,223 @@ def report(path: str, req_id: Optional[int] = None) -> str:
     return "\n".join(out) + "\n"
 
 
+# -- multi-process merge ----------------------------------------------------
+
+
+def _load_doc(dump: Union[str, dict]) -> dict:
+    if isinstance(dump, str):
+        with open(dump) as f:
+            return json.load(f)
+    return dump
+
+
+def _wall_base(doc: dict) -> Optional[float]:
+    """Wall-clock seconds of the dump's normalized t=0, from its
+    ``clockSync`` block: the (mono, wall) pair sampled at export maps
+    the recording clock to wall time, and ``origin_mono_s`` is t=0 in
+    the recording clock."""
+    cs = doc.get("clockSync")
+    if not cs:
+        return None
+    return (cs["wall_s_at_export"] - cs["mono_s_at_export"]
+            + cs["origin_mono_s"])
+
+
+def merge_dumps(dumps: List[Union[str, dict]], out: Optional[str] = None,
+                names: Optional[List[str]] = None) -> dict:
+    """Merge per-process Chrome-trace dumps onto one wall-clock axis.
+
+    Each dump becomes its own pid (with a ``process_name`` metadata row
+    from the dump's ``process`` field / ``names``); "X" events are
+    shifted by the dump's clockSync offset so simultaneous wall-clock
+    moments in different processes line up, then re-normalized so the
+    earliest event across ALL dumps sits at t=0. ``droppedSpans``
+    totals are summed — a merged trace built from lossy rings says so.
+    """
+    docs = [_load_doc(d) for d in dumps]
+    bases: List[Optional[float]] = []
+    for i, doc in enumerate(docs):
+        has_events = any(
+            e.get("ph") == "X" for e in doc.get("traceEvents", ())
+        )
+        base = _wall_base(doc) if has_events else None
+        if has_events and base is None:
+            raise ValueError(
+                f"dump {i} has span events but no clockSync block; "
+                "cannot align clocks (re-export with export_chrome)"
+            )
+        bases.append(base)
+    live = [b for b in bases if b is not None]
+    t0 = min(live) if live else 0.0
+    merged: List[dict] = []
+    dropped = 0
+    proc_names = []
+    for pid, (doc, base) in enumerate(zip(docs, bases), start=1):
+        name = doc.get("process")
+        if names is not None and names[pid - 1]:
+            name = names[pid - 1]
+        if not name:
+            name = f"proc{pid}"
+        proc_names.append(name)
+        merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": name}})
+        for e in doc.get("traceEvents", ()):
+            e = dict(e)
+            e["pid"] = pid
+            if e.get("ph") == "X":
+                e["ts"] = (base - t0) * 1e6 + e["ts"]
+            merged.append(e)
+        dropped += int(doc.get("droppedSpans", 0))
+    result = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "mergedFrom": proc_names,
+        "droppedSpans": dropped,
+    }
+    if out is not None:
+        with open(out, "w") as f:
+            json.dump(result, f)
+    return result
+
+
+# The per-unit critical-path decomposition: span names owned by each
+# phase. "wire" is the CLIENT's view of a round trip (it contains the
+# server's handle time plus the socket itself); "lock" is the PS-side
+# apply under the buffer lock (+ WAL durability).
+_UNIT_PHASES = (
+    ("queue", ("comms/queued",)),
+    ("wire", ("ps/pull", "ps/push")),
+    ("lock", ("ps/apply",)),
+    ("train", ("async/train",)),
+)
+
+
+def unit_table(doc: Union[str, dict]) -> List[dict]:
+    """Per-(epoch, partition) critical-path rows from a (merged) trace:
+    every span carrying a ``trace_id`` joins its unit's ``async/unit``
+    root — including PS-side spans from another process's dump — and the
+    unit's wall splits into queue / wire / lock / train / other.
+    Sorted straggler-first (longest total)."""
+    doc = _load_doc(doc)
+    events = [e for e in doc.get("traceEvents", ())
+              if e.get("ph") == "X" and (e.get("args") or {}).get("trace_id")]
+    by_trace: Dict[str, List[dict]] = {}
+    for e in events:
+        by_trace.setdefault(e["args"]["trace_id"], []).append(e)
+    rows = []
+    for trace_id, evs in by_trace.items():
+        root = next((e for e in evs if e["name"] == "async/unit"), None)
+        if root is None:
+            continue  # a serving request or orphan fragment, not a unit
+        args = root.get("args") or {}
+
+        def total(names):
+            return sum(
+                e.get("dur", 0) for e in evs if e["name"] in names
+            ) / 1e6
+
+        row = {
+            "trace": trace_id[:8],
+            "epoch": args.get("epoch"),
+            "partition": args.get("partition"),
+            "worker": args.get("worker"),
+            "spans": len(evs),
+        }
+        accounted = 0.0
+        for phase, names in _UNIT_PHASES:
+            row[f"{phase}_s"] = total(names)
+            accounted += row[f"{phase}_s"]
+        row["total_s"] = root.get("dur", 0) / 1e6
+        row["other_s"] = max(row["total_s"] - accounted, 0.0)
+        rows.append(row)
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
+def unit_chain_digest(doc: Union[str, dict]) -> int:
+    """Order-independent digest over the SET of completed units (their
+    ``(epoch, partition)`` identities — never the random trace ids or
+    timings), so two replays of the same seeded ``FaultPlan`` chaos run
+    produce the same value even though threads interleave differently.
+    A re-queued unit re-run by a survivor dedupes into one entry."""
+    doc = _load_doc(doc)
+    units = set()
+    for e in doc.get("traceEvents", ()):
+        if e.get("ph") != "X" or e.get("name") != "async/unit":
+            continue
+        args = e.get("args") or {}
+        if args.get("epoch") is not None and args.get("partition") is not None:
+            units.add((str(args["epoch"]), str(args["partition"])))
+    digest = 0
+    for epoch, part in units:
+        digest ^= zlib.crc32(f"{epoch}/{part}".encode())
+    return digest & 0xFFFFFFFF
+
+
+def format_unit_table(rows: List[dict]) -> List[str]:
+    header = (f"{'unit':<12}{'worker':>8}{'queue':>10}{'wire':>10}"
+              f"{'lock':>10}{'train':>10}{'other':>10}{'total':>10}"
+              f"{'spans':>7}")
+    lines = [header, "-" * len(header)]
+    for i, r in enumerate(rows):
+        unit = f"e{r['epoch']}/p{r['partition']}"
+        mark = " <- straggler" if i == 0 and len(rows) > 1 else ""
+        lines.append(
+            f"{unit:<12}{str(r['worker']):>8}"
+            f"{r['queue_s']:>10.4f}{r['wire_s']:>10.4f}{r['lock_s']:>10.4f}"
+            f"{r['train_s']:>10.4f}{r['other_s']:>10.4f}"
+            f"{r['total_s']:>10.4f}{r['spans']:>7}{mark}"
+        )
+    return lines
+
+
+def merge_report(dumps: List[str], out: Optional[str] = None) -> str:
+    merged = merge_dumps(dumps, out=out)
+    n_span = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")
+    lines = [
+        f"# Merged trace: {len(dumps)} dumps "
+        f"({', '.join(merged['mergedFrom'])}), {n_span} span events",
+    ]
+    if merged["droppedSpans"]:
+        lines.append(f"WARNING: {merged['droppedSpans']} spans were "
+                     "dropped by bounded rings before export")
+    if out:
+        lines.append(f"wrote {out}")
+    rows = unit_table(merged)
+    if rows:
+        lines += ["", "## Per-unit critical path (seconds)", ""]
+        lines += format_unit_table(rows)
+        lines += ["", f"unit_chain_digest: "
+                      f"{unit_chain_digest(merged):#010x} "
+                      f"({len(rows)} unit traces)"]
+    else:
+        lines.append("(no async/unit traces — nothing to decompose)")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv: Optional[List[str]] = None) -> str:
     parser = argparse.ArgumentParser(
-        description="Per-phase percentiles + request tree from a trace"
+        description="Per-phase percentiles + request tree from a trace, "
+                    "or a clock-aligned multi-process merge (--merge)"
     )
-    parser.add_argument("trace", help="Chrome trace_event JSON file")
+    parser.add_argument("trace", nargs="+",
+                        help="Chrome trace_event JSON file(s)")
+    parser.add_argument("--merge", action="store_true",
+                        help="merge per-process dumps (clockSync-aligned) "
+                             "and print the per-unit critical-path table")
     parser.add_argument("--tree-req", type=int, default=None,
                         help="draw the tree for this req_id")
     parser.add_argument("--out", default=None,
-                        help="also write the report to this file")
+                        help="write the merged trace (--merge) or the "
+                             "report text to this file")
     args = parser.parse_args(argv)
-    text = report(args.trace, req_id=args.tree_req)
+    if args.merge:
+        text = merge_report(args.trace, out=args.out)
+        print(text, end="")
+        return text
+    if len(args.trace) > 1:
+        parser.error("multiple trace files require --merge")
+    text = report(args.trace[0], req_id=args.tree_req)
     if args.out:
         with open(args.out, "w") as f:
             f.write(text)

@@ -98,14 +98,18 @@ def test_pull_orders_after_earlier_pushes():
 def test_transient_push_failure_retries_same_delta():
     client = FakeClient(record_before_raise=True)
     client.push_failures["d0"] = [RuntimeError("flake"), RuntimeError("flake")]
-    before = obs.default_registry().counter("ps_push_retry_total").value
+    retries = obs.default_registry().counter(
+        "ps_push_retry_total", labelnames=("worker",))
+    before = retries.value
     with _closing(_CommsPipeline(client, 0, max_push_attempts=4)) as pipe:
         pipe.push("d0")
         pipe.flush()
     # Applied on every attempt: the double-push (at-least-once) contract.
     assert client.pushed == ["d0", "d0", "d0"]
-    after = obs.default_registry().counter("ps_push_retry_total").value
+    after = retries.value
     assert after - before == 2
+    # The retry counter carries the worker dimension as a label now.
+    assert retries.labels(worker="w0").value >= 2
 
 
 def test_push_retries_exhausted_becomes_fatal():

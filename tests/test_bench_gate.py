@@ -1,0 +1,160 @@
+"""Unit tests for ``scripts/bench_gate.py`` — the mechanical diff of a
+fresh bench run against the committed artifact. ``compare``/``gate`` are
+pure, so these feed literal rows; one test drives ``main`` end-to-end on
+temp files to pin the exit-code contract CI gates on.
+"""
+
+import json
+
+import pytest
+
+import scripts.bench_gate as bg
+
+
+def _checks_by_metric(checks):
+    return {(c["key"], c["metric"]): c for c in checks}
+
+
+def test_higher_direction_floor():
+    base = [{"scenario": "s", "completed_units": 6, "wall_s": 2.0}]
+    # chaos wall_s is "lower" with tol 1.00 → ceiling 4.0
+    ok = bg.compare(base, [{"scenario": "s", "completed_units": 6,
+                            "wall_s": 3.9}], "chaos")
+    assert all(c["ok"] for c in ok)
+    slow = bg.compare(base, [{"scenario": "s", "completed_units": 6,
+                              "wall_s": 4.1}], "chaos")
+    failed = [c for c in slow if not c["ok"]]
+    assert [c["metric"] for c in failed] == ["wall_s"]
+    assert "<= 4" in failed[0]["threshold"]
+
+
+def test_ps_higher_metric_fails_below_floor():
+    base = [{"mode": "socket", "codec": "packed", "op": "push",
+             "quantize": None, "pipelined": True, "mb_per_s": 100.0}]
+    fresh = [dict(base[0], mb_per_s=49.0)]  # floor is 100*(1-0.50) = 50
+    checks = bg.compare(base, fresh, "ps")
+    assert [c["ok"] for c in checks] == [False]
+    fresh[0]["mb_per_s"] = 51.0
+    assert all(c["ok"] for c in bg.compare(base, fresh, "ps"))
+
+
+def test_equal_direction_is_exact():
+    base = [{"scenario": "s", "completed_units": 6}]
+    assert all(c["ok"] for c in bg.compare(
+        base, [{"scenario": "s", "completed_units": 6}], "chaos"))
+    bad = bg.compare(base, [{"scenario": "s", "completed_units": 5}],
+                     "chaos")
+    assert [c["ok"] for c in bad] == [False]
+
+
+def test_limit_direction_ignores_baseline():
+    """The serving trace-overhead guardrail is an absolute ceiling: even
+    a fresh value better than baseline fails if it crosses 2%."""
+    base = [{"mode": "decode", "pipeline": "on", "overhead_pct": 5.0}]
+    over = bg.compare(base, [{"mode": "decode", "pipeline": "on",
+                              "overhead_pct": 2.5}], "serve")
+    assert [c["ok"] for c in over] == [False]
+    under = bg.compare(base, [{"mode": "decode", "pipeline": "on",
+                               "overhead_pct": 1.2}], "serve")
+    assert [c["ok"] for c in under] == [True]
+
+
+def test_missing_fresh_row_fails_row_present():
+    base = [{"scenario": "kill_ps", "wall_s": 6.5}]
+    checks = bg.compare(base, [{"scenario": "baseline", "wall_s": 2.0}],
+                        "chaos")
+    assert len(checks) == 1
+    assert checks[0]["metric"] == "row_present"
+    assert not checks[0]["ok"]
+
+
+def test_missing_fresh_metric_fails():
+    base = [{"scenario": "s", "wall_s": 2.0, "completed_units": 6}]
+    fresh = [{"scenario": "s", "wall_s": 2.0}]
+    by = _checks_by_metric(bg.compare(base, fresh, "chaos"))
+    assert not by[("s", "completed_units")]["ok"]
+    assert by[("s", "wall_s")]["ok"]
+
+
+def test_meta_rows_are_skipped():
+    """Rows carrying only config (the chaos ``meta`` row, serve config
+    headers) produce no checks — they aren't gated metrics."""
+    base = [{"scenario": "meta", "epochs": 3, "workers": 2}]
+    assert bg.compare(base, [], "chaos") == []
+
+
+def test_extra_fresh_rows_are_ignored():
+    base = [{"scenario": "s", "completed_units": 6}]
+    fresh = [{"scenario": "s", "completed_units": 6},
+             {"scenario": "new_mode", "completed_units": 9}]
+    assert all(c["ok"] for c in bg.compare(base, fresh, "chaos"))
+
+
+def test_rows_join_on_identity_not_position():
+    base = [{"mode": "a", "pipeline": "x", "tokens_per_sec": 100.0},
+            {"mode": "b", "pipeline": "x", "tokens_per_sec": 10.0}]
+    fresh = list(reversed([dict(r) for r in base]))
+    assert all(c["ok"] for c in bg.compare(base, fresh, "serve"))
+
+
+def test_gate_rolls_up_verdict():
+    base = [{"scenario": "s", "completed_units": 6}]
+    good = bg.gate({"chaos": (base, [dict(base[0])])})
+    assert good["verdict"] == "pass"
+    assert good["by_kind"]["chaos"] == {"checks": 1, "failures": 0}
+    bad = bg.gate({"chaos": (base, [])})
+    assert bad["verdict"] == "fail"
+    assert bad["failures"][0]["metric"] == "row_present"
+
+
+def test_load_rows_handles_array_and_jsonl(tmp_path):
+    rows = [{"a": 1}, {"a": 2}]
+    arr = tmp_path / "arr.json"
+    arr.write_text(json.dumps(rows))
+    jsonl = tmp_path / "rows.jsonl"
+    jsonl.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert bg.load_rows(str(arr)) == rows
+    assert bg.load_rows(str(jsonl)) == rows
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    assert bg.load_rows(str(empty)) == []
+
+
+def test_main_exit_code_and_out_file(tmp_path, capsys):
+    base = tmp_path / "base.jsonl"
+    base.write_text(json.dumps({"scenario": "s", "completed_units": 6}))
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps({"scenario": "s", "completed_units": 6}))
+    out = tmp_path / "verdict.json"
+    verdict = bg.main(["--chaos", str(base), str(good),
+                       "--out", str(out)])
+    assert verdict["verdict"] == "pass"
+    assert json.loads(out.read_text())["verdict"] == "pass"
+    capsys.readouterr()
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({"scenario": "s", "completed_units": 5}))
+    with pytest.raises(SystemExit) as exc:
+        bg.main(["--chaos", str(base), str(bad)])
+    assert exc.value.code == 1
+    assert '"verdict": "fail"' in capsys.readouterr().out
+
+
+def test_committed_artifacts_self_compare():
+    """The committed baselines must pass the gate against themselves —
+    pins that every artifact's shape is readable and every rule's key
+    fields actually exist in the real files."""
+    import pathlib
+
+    root = pathlib.Path(bg.__file__).resolve().parent.parent
+    pairs = {}
+    for kind, name in (("serve", "BENCH_SERVE.json"),
+                       ("ps", "BENCH_PS.json"),
+                       ("chaos", "BENCH_CHAOS.json")):
+        path = root / name
+        if path.exists():
+            rows = bg.load_rows(str(path))
+            pairs[kind] = (rows, rows)
+    assert pairs, "no committed bench artifacts found"
+    verdict = bg.gate(pairs)
+    assert verdict["verdict"] == "pass", verdict["failures"]

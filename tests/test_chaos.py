@@ -159,6 +159,58 @@ def test_kill_ps_warm_restart_completes_within_tolerance(
     assert abs(history["loss"][-1] - baseline_loss) < 0.02
 
 
+def test_traced_chaos_merged_digest_is_replay_stable(blobs_xy, tmp_path):
+    """Two seeded-FaultPlan chaos fits under the tracer produce the SAME
+    merged-trace unit-chain digest: the digest covers the SET of
+    completed (epoch, partition) units — never the random trace ids or
+    timings — so deterministic replay survives thread interleaving and
+    the requeue the kill forces. Along the way this pins the acceptance
+    join: worker-side ps/push and PS-side apply spans share trace ids
+    across the socket in the merged doc."""
+    from elephas_tpu import obs
+
+    import scripts.chaos_bench as chaos_bench
+    import scripts.trace_report as trace_report
+
+    x, y = blobs_xy
+    digests = []
+    for run in range(2):
+        tracer = obs.enable_tracing(capacity=65536, annotate_device=False)
+        try:
+            plan = FaultPlan(seed=11, kill_worker_at={"w1": 1})
+            trainer = _trainer(fault_plan=plan)
+            trainer.fit(ShardedDataset(x, y, PARTITIONS),
+                        epochs=EPOCHS, batch_size=16)
+            assert trainer.elastic_stats["completed_units"] == UNITS
+            outdir = str(tmp_path / f"run{run}")
+            import os
+            os.makedirs(outdir)
+            worker_path, ps_path = chaos_bench.export_role_dumps(
+                tracer, outdir)
+            merged = trace_report.merge_dumps([worker_path, ps_path])
+        finally:
+            obs.disable_tracing()
+
+        rows = trace_report.unit_table(merged)
+        # Every (epoch, partition) unit decomposed; requeued re-runs may
+        # add extra traces, but never lose a unit.
+        units = {(r["epoch"], r["partition"]) for r in rows}
+        assert len(units) == UNITS
+        # The cross-socket join: a PS-side apply joined a worker-rooted
+        # trace, so some unit shows PS lock time.
+        worker_traces = {
+            (e.get("args") or {}).get("trace_id")
+            for e in merged["traceEvents"] if e.get("name") == "ps/push"
+        }
+        apply_traces = {
+            (e.get("args") or {}).get("trace_id")
+            for e in merged["traceEvents"] if e.get("name") == "ps/apply"
+        }
+        assert worker_traces & apply_traces
+        digests.append(trace_report.unit_chain_digest(merged))
+    assert digests[0] == digests[1]
+
+
 def test_partition_window_is_ridden_out(blobs_xy, baseline_loss):
     """A deterministic partition (frames 6..13 per peer vanish) pushes
     some round trips past their retry budget; the pool re-queues and

@@ -224,6 +224,53 @@ def test_resilience_lint_allows_default_values_and_pragma(tmp_path):
     assert lint.lint_resilience_file(ok) == []
 
 
+def test_obs_metric_names_conform():
+    """THE metric-naming invariant: every literal counter name in the
+    package ends ``_total``, every histogram ``_seconds``, and no metric
+    name is assembled from an f-string (dimensions belong in
+    ``labelnames=``, not baked into the name)."""
+    root = Path(lint.__file__).resolve().parent.parent / "elephas_tpu"
+    assert root.is_dir()
+    violations = lint.lint_metric_package(root)
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_metric_lint_catches_each_form(tmp_path):
+    bad = tmp_path / "bad_metrics.py"
+    bad.write_text(textwrap.dedent("""
+        def f(reg, program):
+            a = reg.counter("push_count")
+            b = reg.counter(f"retrace_total::{program}")
+            c = reg.histogram("latency_ms")
+            return a, b, c
+    """))
+    names = sorted(v.call for v in lint.lint_metric_file(bad))
+    assert names == [
+        "<f-string> in .counter()",
+        "`latency_ms` in .histogram()",
+        "`push_count` in .counter()",
+    ]
+    msg = str(lint.lint_metric_file(bad)[0])
+    assert "labelnames=" in msg
+
+
+def test_metric_lint_passes_sanctioned_shapes(tmp_path):
+    """Conforming suffixes, dynamic names held in variables (linted at
+    their literal definition site), gauges (no suffix convention), and
+    the ``# metric-ok`` pragma all pass."""
+    ok = tmp_path / "ok_metrics.py"
+    ok.write_text(textwrap.dedent("""
+        def f(reg, name):
+            a = reg.counter("ps_push_retry_total", labelnames=("worker",))
+            b = reg.histogram("train_epoch_seconds")
+            c = reg.counter(name)
+            d = reg.gauge("queue_depth")
+            e = reg.counter("legacy_bridge_count")  # metric-ok: external schema
+            return a, b, c, d, e
+    """))
+    assert lint.lint_metric_file(ok) == []
+
+
 def test_cli_reports_clean(capsys):
     assert lint.main([]) == []
     assert "clean" in capsys.readouterr().out

@@ -14,8 +14,10 @@ import pytest
 
 from elephas_tpu import obs
 from elephas_tpu.obs import (
+    NULL_FLIGHT_RECORDER,
     NULL_TRACER,
     Counter,
+    FlightRecorder,
     Gauge,
     Histogram,
     MetricsRegistry,
@@ -221,15 +223,195 @@ def test_note_retrace_counts_and_marks():
     from elephas_tpu.utils.compiler import note_retrace
 
     reg = obs.default_registry()
-    before = reg.counter("retrace_total").value
+    family = reg.counter("retrace_total", labelnames=("program",))
+    before = family.value
     tr = obs.enable_tracing(capacity=8, annotate_device=False)
     try:
         note_retrace("unit_test_prog", count=1)
     finally:
         obs.disable_tracing()
-    assert reg.counter("retrace_total").value == before + 1
-    assert reg.counter("retrace_total::unit_test_prog").value >= 1
+    assert family.value == before + 1
+    assert family.labels(program="unit_test_prog").value >= 1
     assert any(e.name == "compile/unit_test_prog" for e in tr.events())
+
+
+# -- distributed trace context ---------------------------------------------
+
+
+def test_new_context_mints_distinct_roots():
+    a, b = obs.new_context(), obs.new_context()
+    assert a.trace_id != b.trace_id and a.span_id != b.span_id
+    assert len(a.trace_id) == 16
+    assert obs.current_context() is None  # minting never activates
+
+
+def test_activate_nests_spans_into_a_causal_tree():
+    clock = FakeClock()
+    tr = Tracer(clock=clock, annotate_device=False)
+    ctx = obs.new_context()
+    with obs.activate(ctx):
+        assert obs.current_context() == ctx
+        with tr.span("outer") as outer:
+            assert outer.context.trace_id == ctx.trace_id
+            with tr.span("inner"):
+                clock.advance(0.1)
+    assert obs.current_context() is None  # token-restored on exit
+    inner, outer_e = tr.events()  # rings append at span EXIT
+    assert inner.trace_id == outer_e.trace_id == ctx.trace_id
+    assert outer_e.parent_id == ctx.span_id
+    assert inner.parent_id == outer_e.span_id
+
+
+def test_untraced_spans_mint_no_ids():
+    """No active context → spans carry no ids at all, so untraced runs
+    keep the legacy event shape (and skip the id mint entirely)."""
+    tr = Tracer(clock=FakeClock(), annotate_device=False)
+    with tr.span("alone") as sp:
+        assert sp.context is None
+    tr.record("leaf", 0.0, 1.0)
+    assert all(e.trace_id is None and e.parent_id is None
+               for e in tr.events())
+
+
+def test_activate_none_detaches():
+    tr = Tracer(clock=FakeClock(), annotate_device=False)
+    with obs.activate(obs.new_context()):
+        with obs.activate(None):  # e.g. a helper that must not inherit
+            with tr.span("detached"):
+                pass
+        assert obs.current_context() is not None
+    assert tr.events()[0].trace_id is None
+
+
+def test_record_and_instant_tag_as_leaves():
+    """Retroactive spans (the serving scheduler's style) join the active
+    trace as LEAVES — they never become parents, so the hot path pays
+    one contextvar read and no context install."""
+    tr = Tracer(clock=FakeClock(), annotate_device=False)
+    ctx = obs.new_context()
+    with obs.activate(ctx):
+        tr.record("queue", 0.0, 0.1)
+        tr.instant("finish")
+        assert obs.current_context() == ctx  # unchanged by record()
+    queue, finish = tr.events()
+    assert queue.trace_id == finish.trace_id == ctx.trace_id
+    assert queue.parent_id == finish.parent_id == ctx.span_id
+
+
+def test_ring_overwrite_counts_dropped_spans():
+    global_counter = obs.default_registry().counter(
+        "tracer_dropped_spans_total")
+    before = global_counter.value
+    tr = Tracer(capacity=2, clock=FakeClock(), annotate_device=False)
+    for i in range(5):
+        tr.record(f"e{i}", float(i), float(i) + 0.5)
+    assert tr.dropped == 3
+    assert global_counter.value == before + 3
+    assert len(tr) == 2  # ring still bounded
+
+
+def test_wire_trace_context_roundtrip():
+    """The packed codec carries the sender's (trace_id, span_id) in its
+    header — and omits it entirely when untraced, so frames from
+    untraced processes stay byte-identical with older peers."""
+    import numpy as np
+
+    from elephas_tpu.parameter import wire
+
+    tree = {"w": np.ones((2, 3), np.float32)}
+    tc = ("0123456789abcdef", "aa01")
+    traced = wire.encode_tree(tree, version=4, trace=tc).tobytes()
+    got, got_tc = wire.decode_payload_traced(traced)
+    assert got_tc == tc
+    np.testing.assert_array_equal(got["w"], tree["w"])
+    assert wire.decode(traced).trace == tc
+
+    plain = wire.encode_tree(tree, version=4).tobytes()
+    _, no_tc = wire.decode_payload_traced(plain)
+    assert no_tc is None
+    assert b"tc" not in plain  # header key absent, not null
+
+
+# -- labeled metric families -------------------------------------------------
+
+
+def test_family_labels_children_and_sum():
+    reg = MetricsRegistry()
+    fam = reg.counter("bytes_tx_total", help="sent", labelnames=("transport",))
+    fam.labels(transport="http").inc(3)
+    fam.labels(transport="socket").inc(4)
+    assert fam.labels(transport="http") is fam.labels(transport="http")
+    assert fam.labels(transport="http").value == 3
+    assert fam.value == 7  # family sums the dimension
+    with pytest.raises(ValueError):
+        fam.labels(mode="http")  # wrong label schema
+
+
+def test_family_registration_conflicts_fail_loudly():
+    reg = MetricsRegistry()
+    reg.counter("x_total", labelnames=("worker",))
+    with pytest.raises(TypeError):
+        reg.counter("x_total")  # labeled → plain
+    with pytest.raises(TypeError):
+        reg.counter("x_total", labelnames=("transport",))  # schema change
+    with pytest.raises(TypeError):
+        reg.gauge("x_total", labelnames=("worker",))  # kind change
+    reg.counter("y_total")
+    with pytest.raises(TypeError):
+        reg.counter("y_total", labelnames=("worker",))  # plain → labeled
+
+
+def test_family_exposition_one_line_per_child():
+    reg = MetricsRegistry()
+    fam = reg.counter("pulls_total", help="pulls", labelnames=("transport",))
+    fam.labels(transport="http").inc(2)
+    fam.labels(transport="socket").inc(5)
+    text = reg.expose_text()
+    assert "# TYPE pulls_total counter" in text
+    assert 'pulls_total{transport="http"} 2' in text
+    assert 'pulls_total{transport="socket"} 5' in text
+    snap = reg.snapshot()
+    assert snap['pulls_total{transport="http"}'] == 2
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flight_note_filter_and_snapshot(tmp_path):
+    fr = FlightRecorder(capacity=8, clock=FakeClock(5.0))
+    fr.note("wal_restore", "info", version=3)
+    fr.note("heartbeat_flap", worker="w1")  # default severity: warn
+    fr.note("ps_kill", "error", boot="abc123")
+    with pytest.raises(ValueError):
+        fr.note("bad", "fatal")
+    assert [e.kind for e in fr.events()] == [
+        "wal_restore", "heartbeat_flap", "ps_kill"]
+    assert [e.kind for e in fr.events(min_severity="warn")] == [
+        "heartbeat_flap", "ps_kill"]
+    assert [e.detail["worker"] for e in
+            fr.events(kind="heartbeat_flap")] == ["w1"]
+    snap = fr.snapshot()
+    assert snap["counts_by_kind"] == {
+        "wal_restore": 1, "heartbeat_flap": 1, "ps_kill": 1}
+    path = fr.dump(str(tmp_path / "flight.json"))
+    doc = json.loads(open(path).read())
+    assert doc["counts_by_kind"]["ps_kill"] == 1
+    assert doc["events"][0]["detail"] == {"version": 3}
+
+
+def test_flight_tags_active_trace_and_bounds_ring():
+    fr = FlightRecorder(capacity=2)
+    ctx = obs.new_context()
+    with obs.activate(ctx):
+        event = fr.note("stale_notmod", version=9)
+    assert event.trace_id == ctx.trace_id
+    assert fr.note("plain").trace_id is None
+    fr.note("one_more")  # third event into a 2-ring
+    assert fr.dropped == 1 and len(fr) == 2
+    assert fr.snapshot()["dropped"] == 1
+    fr.clear()
+    assert len(fr) == 0 and fr.dropped == 0
+    assert NULL_FLIGHT_RECORDER.note("anything") is None  # disabled: free
 
 
 # -- trace_report ----------------------------------------------------------
@@ -299,6 +481,142 @@ def test_trace_report_exact_percentile():
     assert trace_report.percentile([3.0], 0.9) == 3.0
     with pytest.raises(ValueError):
         trace_report.percentile([], 0.5)
+
+
+# -- trace_report merge mode ------------------------------------------------
+
+
+def _dump(events, process, origin_mono, mono_at_export, wall_at_export,
+          dropped=0):
+    """Synthetic per-process dump: normalized events + the clockSync
+    block ``export_events`` emits."""
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "clockSync": {
+            "origin_mono_s": origin_mono,
+            "mono_s_at_export": mono_at_export,
+            "wall_s_at_export": wall_at_export,
+        },
+        "droppedSpans": dropped,
+        "process": process,
+    }
+
+
+def _x(name, ts_us, dur_us, **args):
+    e = {"name": name, "ph": "X", "pid": 0, "tid": 1,
+         "ts": ts_us, "dur": dur_us}
+    if args:
+        e["args"] = args
+    return e
+
+
+def test_merge_aligns_distinct_clock_domains(tmp_path):
+    """Two dumps whose monotonic clocks have arbitrary bases: events
+    that happened at the same WALL moment land on the same merged ts."""
+    # worker: t=0 at mono 100; exported at (mono 110, wall 1000)
+    #   → its t=0 is wall 990; event at ts=0 happened at wall 990.
+    worker = _dump([_x("ps/push", 0.0, 5e5)], "worker", 100.0, 110.0, 1000.0)
+    # ps: t=0 at mono 5; exported at (mono 20, wall 1000)
+    #   → its t=0 is wall 985; event at ts=5e6 happened at wall 990 too.
+    ps = _dump([_x("ps/handle_push", 5e6, 4e5)], "ps", 5.0, 20.0, 1000.0)
+    out = str(tmp_path / "merged.json")
+    merged = trace_report.merge_dumps([worker, ps], out=out)
+    assert json.loads(open(out).read()) == merged
+    xs = {e["name"]: e for e in merged["traceEvents"] if e["ph"] == "X"}
+    assert xs["ps/push"]["ts"] == pytest.approx(xs["ps/handle_push"]["ts"])
+    assert xs["ps/push"]["pid"] != xs["ps/handle_push"]["pid"]
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {"worker", "ps"}
+    assert merged["mergedFrom"] == ["worker", "ps"]
+
+
+def test_merge_requires_clocksync_for_span_dumps():
+    bad = {"traceEvents": [_x("a", 0.0, 1.0)]}
+    with pytest.raises(ValueError, match="clockSync"):
+        trace_report.merge_dumps([bad])
+    # An EMPTY dump without clockSync is fine (a quiet process's /trace).
+    merged = trace_report.merge_dumps([{"traceEvents": []}])
+    assert [e for e in merged["traceEvents"] if e.get("ph") == "X"] == []
+
+
+def test_merge_sums_dropped_spans():
+    a = _dump([_x("a", 0.0, 1.0)], "w0", 0.0, 1.0, 100.0, dropped=3)
+    b = _dump([_x("b", 0.0, 1.0)], "w1", 0.0, 1.0, 100.0, dropped=4)
+    assert trace_report.merge_dumps([a, b])["droppedSpans"] == 7
+
+
+def _unit_events(trace_id, epoch, part, worker, scale_us=1.0):
+    """One unit's span set: root + one span per critical-path phase."""
+    tid = {"trace_id": trace_id}
+    return [
+        _x("async/unit", 0.0, 100 * scale_us, epoch=epoch, partition=part,
+           worker=worker, **tid),
+        _x("comms/queued", 1.0, 10 * scale_us, **tid),
+        _x("ps/pull", 12.0, 20 * scale_us, **tid),
+        _x("ps/push", 40.0, 10 * scale_us, **tid),
+        _x("ps/apply", 45.0, 5 * scale_us, **tid),
+        _x("async/train", 55.0, 40 * scale_us, **tid),
+    ]
+
+
+def test_unit_table_decomposes_critical_path():
+    doc = {"traceEvents":
+           _unit_events("aaaa0000aaaa0000", 0, 0, "w0")
+           + _unit_events("bbbb0000bbbb0000", 0, 1, "w1", scale_us=2.0)
+           + [_x("ps/handle_push", 0.0, 9.0, trace_id="orphan")]}
+    rows = trace_report.unit_table(doc)
+    assert len(rows) == 2  # the rootless fragment is not a unit
+    straggler, other = rows
+    assert (straggler["epoch"], straggler["partition"]) == (0, 1)
+    assert straggler["total_s"] == pytest.approx(200e-6)
+    assert straggler["queue_s"] == pytest.approx(20e-6)
+    assert straggler["wire_s"] == pytest.approx(60e-6)  # pull + push
+    assert straggler["lock_s"] == pytest.approx(10e-6)
+    assert straggler["train_s"] == pytest.approx(80e-6)
+    assert straggler["other_s"] == pytest.approx(30e-6)
+    assert other["worker"] == "w0" and other["spans"] == 6
+    lines = trace_report.format_unit_table(rows)
+    assert lines[2].startswith("e0/p1") and "<- straggler" in lines[2]
+    assert "straggler" not in lines[3]
+
+
+def test_unit_chain_digest_is_order_independent_and_dedupes():
+    a = {"traceEvents": _unit_events("t1", 0, 0, "w0")
+         + _unit_events("t2", 0, 1, "w1")}
+    b = {"traceEvents": _unit_events("x9", 0, 1, "w0")  # other ids/workers
+         + _unit_events("x8", 0, 0, "w1")
+         + _unit_events("x7", 0, 0, "w1")}  # re-run unit dedupes
+    assert trace_report.unit_chain_digest(a) == \
+        trace_report.unit_chain_digest(b)
+    c = {"traceEvents": _unit_events("t1", 1, 0, "w0")}  # different unit set
+    assert trace_report.unit_chain_digest(a) != \
+        trace_report.unit_chain_digest(c)
+
+
+def test_merge_report_end_to_end(tmp_path):
+    wpath, ppath = str(tmp_path / "w.json"), str(tmp_path / "p.json")
+    unit = _unit_events("cafe0000cafe0000", 2, 0, "w0")
+    json.dump(_dump([e for e in unit if e["name"] != "ps/apply"],
+                    "worker", 0.0, 1.0, 100.0), open(wpath, "w"))
+    json.dump(_dump([e for e in unit if e["name"] == "ps/apply"],
+                    "ps", 0.0, 1.0, 100.0), open(ppath, "w"))
+    out = str(tmp_path / "merged.json")
+    text = trace_report.main([wpath, ppath, "--merge", "--out", out])
+    assert "Per-unit critical path" in text
+    assert "e2/p0" in text and "unit_chain_digest" in text
+    # The PS-side apply span joined the worker-rooted trace on trace_id.
+    rows = trace_report.unit_table(json.loads(open(out).read()))
+    assert rows[0]["lock_s"] > 0 and rows[0]["spans"] == 6
+
+
+def test_multiple_traces_without_merge_is_an_error(tmp_path, capsys):
+    p = str(tmp_path / "a.json")
+    json.dump({"traceEvents": []}, open(p, "w"))
+    with pytest.raises(SystemExit):
+        trace_report.main([p, p])
+    capsys.readouterr()
 
 
 # -- serving metrics percentiles -------------------------------------------

@@ -1,0 +1,167 @@
+"""Live ops endpoint (``elephas_tpu.obs.opsd``): every route exercised
+against a real started server — standalone, and mounted on a running
+parameter server — plus the loopback-by-default security posture.
+
+These tests make actual HTTP requests over loopback: the acceptance
+criterion is routes served *by a live process*, not handler functions
+called directly.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from elephas_tpu import obs
+from elephas_tpu.obs import FlightRecorder, MetricsRegistry, Tracer
+from elephas_tpu.obs.opsd import OpsServer
+
+
+def _get(url, timeout=5.0):
+    """(status, content_type, body_bytes) for a GET, 4xx/5xx included."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.headers.get("Content-Type"), resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.headers.get("Content-Type"), err.read()
+
+
+def _get_json(url):
+    status, _, body = _get(url)
+    return status, json.loads(body)
+
+
+@pytest.fixture()
+def ops():
+    """A started OpsServer with its OWN surfaces (not process globals),
+    so assertions don't race other tests' instrumentation."""
+    registry = MetricsRegistry()
+    registry.counter("pulls_total", help="pulls",
+                     labelnames=("transport",)).labels(
+                         transport="socket").inc(3)
+    tracer = Tracer(annotate_device=False)
+    with tracer.span("ps/handle_pull", boot="boot01"):
+        pass
+    flight = FlightRecorder(capacity=8)
+    flight.note("wal_restore", "info", version=2)
+    server = OpsServer(port=0, registry=registry, tracer=tracer,
+                       flight=flight,
+                       vars_fn=lambda: {"role": "test", "version": 7},
+                       health_fn=lambda: {"workers_alive": 2})
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_metrics_route_serves_prometheus_text(ops):
+    status, ctype, body = _get(f"{ops.url}/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    text = body.decode()
+    assert "# TYPE pulls_total counter" in text
+    assert 'pulls_total{transport="socket"} 3' in text
+
+
+def test_healthz_route_merges_health_fn(ops):
+    status, doc = _get_json(f"{ops.url}/healthz")
+    assert status == 200
+    assert doc["status"] == "ok"
+    assert doc["uptime_s"] >= 0
+    assert doc["workers_alive"] == 2
+
+
+def test_trace_route_is_a_mergeable_dump(ops):
+    """/trace serves exactly the per-process dump trace_report --merge
+    aligns: Chrome events plus the clockSync block."""
+    import scripts.trace_report as trace_report
+
+    status, doc = _get_json(f"{ops.url}/trace")
+    assert status == 200
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in spans] == ["ps/handle_pull"]
+    assert {"origin_mono_s", "mono_s_at_export",
+            "wall_s_at_export"} <= set(doc["clockSync"])
+    merged = trace_report.merge_dumps([doc])
+    assert sum(1 for e in merged["traceEvents"] if e["ph"] == "X") == 1
+
+
+def test_vars_route_identity(ops):
+    status, doc = _get_json(f"{ops.url}/vars")
+    assert status == 200
+    assert doc["role"] == "test" and doc["version"] == 7
+    assert doc["ops_port"] == ops.port and isinstance(doc["pid"], int)
+
+
+def test_flight_route_serves_ring_snapshot(ops):
+    status, doc = _get_json(f"{ops.url}/flight")
+    assert status == 200
+    assert doc["counts_by_kind"] == {"wal_restore": 1}
+    assert doc["events"][0]["detail"] == {"version": 2}
+
+
+def test_unknown_route_is_404(ops):
+    status, doc = _get_json(f"{ops.url}/nope")
+    assert status == 404
+    assert doc["path"] == "/nope"
+
+
+def test_failing_health_fn_answers_500():
+    """A health route that lies is worse than one that fails."""
+
+    def broken():
+        raise RuntimeError("membership table gone")
+
+    server = OpsServer(port=0, registry=MetricsRegistry(),
+                       tracer=Tracer(annotate_device=False, enabled=False),
+                       flight=FlightRecorder(capacity=1),
+                       health_fn=broken)
+    server.start()
+    try:
+        status, doc = _get_json(f"{server.url}/healthz")
+        assert status == 500
+        assert "membership table gone" in doc["error"]
+    finally:
+        server.stop()
+
+
+def test_binds_loopback_by_default(monkeypatch):
+    monkeypatch.delenv("ELEPHAS_OPS_BIND", raising=False)
+    server = OpsServer(port=0)
+    assert server.host == "127.0.0.1"
+    monkeypatch.setenv("ELEPHAS_OPS_BIND", "0.0.0.0")
+    assert OpsServer(port=0).host == "0.0.0.0"
+
+
+def test_ps_server_mounts_ops_and_unmounts_on_stop():
+    """ops_port=0 on a PS server mounts a live endpoint whose /vars
+    answers with the boot id + live buffer version; stop() unmounts."""
+    from elephas_tpu.parameter.server import SocketServer
+
+    params = {"dense": {"w": np.ones((4, 4), np.float32)}}
+    server = SocketServer(params, lock=True, port=0, ops_port=0)
+    server.start()
+    try:
+        assert server.ops is not None and server.ops.port
+        url = server.ops.url
+        status, doc = _get_json(f"{url}/vars")
+        assert status == 200
+        assert doc["boot"] == server.boot
+        assert doc["version"] == server.buffer.version
+        assert doc["transport"] == "socket"
+        status, doc = _get_json(f"{url}/healthz")
+        assert status == 200 and doc["status"] == "ok"
+
+        client = server.client()
+        delta = {"dense": {"w": np.full((4, 4), 0.25, np.float32)}}
+        client.update_parameters(delta)
+        client.close()
+        # /vars reads are live, not mount-time snapshots.
+        _, doc = _get_json(f"{url}/vars")
+        assert doc["version"] == 1
+    finally:
+        server.stop()
+    assert server.ops is None
+    with pytest.raises(urllib.error.URLError):
+        _get(f"{url}/healthz", timeout=0.5)

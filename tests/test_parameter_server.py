@@ -445,20 +445,86 @@ def test_ps_byte_counters_move():
     from elephas_tpu import obs
 
     reg = obs.default_registry()
-    tx0 = reg.counter("ps_bytes_tx").value
-    rx0 = reg.counter("ps_bytes_rx").value
+    tx = reg.counter("ps_bytes_tx_total", labelnames=("transport",))
+    rx = reg.counter("ps_bytes_rx_total", labelnames=("transport",))
+    tx0 = tx.labels(transport="http").value
+    rx0 = rx.labels(transport="http").value
     server = HttpServer(_params(), lock=True, port=0)
     server.start()
     try:
         client = server.client()
         client.get_parameters()
-        assert reg.counter("ps_bytes_tx").value > tx0  # pull left the server
+        # pull left the server, on the http transport's label child
+        assert tx.labels(transport="http").value > tx0
         delta = {"dense": {"w": np.full((4, 4), 0.5, np.float32),
                            "b": np.zeros(4, np.float32)}}
         client.update_parameters(delta)
-        assert reg.counter("ps_bytes_rx").value > rx0  # push reached it
+        assert rx.labels(transport="http").value > rx0  # push reached it
     finally:
         server.stop()
+
+
+def test_trace_id_survives_kill_and_warm_restart(tmp_path):
+    """THE distributed-trace propagation invariant: a client pushing
+    inside an active trace context makes the PS-side handle spans
+    children of that trace ACROSS the socket — and across a kill plus
+    warm restart on the same port, the trace id stays the client's while
+    the boot id changes, so a merged trace shows one causal chain
+    through two server incarnations. The kill also dumps the flight
+    recorder next to the WAL."""
+    import json
+    import os
+
+    from elephas_tpu import obs
+
+    wal_dir = str(tmp_path / "wal")
+    os.makedirs(wal_dir)
+    delta = {"dense": {"w": np.full((4, 4), 0.25, np.float32),
+                       "b": np.zeros(4, np.float32)}}
+    tr = obs.enable_tracing(capacity=1024, annotate_device=False)
+    obs.default_flight_recorder().clear()  # hermetic vs earlier kills
+    try:
+        server = SocketServer(_params(), lock=True, port=0, wal_dir=wal_dir)
+        server.start()
+        port, boot1 = server.port, server.boot
+        ctx = obs.new_context()
+        client = server.client()
+        with obs.activate(ctx):
+            client.update_parameters(delta)
+        client.close()
+        server.kill()
+        assert server.flight_dump and os.path.exists(server.flight_dump)
+        dump = json.loads(open(server.flight_dump).read())
+        assert dump["counts_by_kind"]["ps_kill"] == 1
+
+        # Warm restart: same port, same WAL, NEW boot id.
+        fresh = SocketServer(_params(), lock=True, port=port,
+                             wal_dir=wal_dir)
+        fresh.start()
+        boot2 = fresh.boot
+        assert boot2 != boot1
+        assert fresh.buffer.version >= 1  # WAL superseded the cold init
+        client2 = fresh.client()
+        with obs.activate(ctx):  # the unit's trace continues
+            client2.update_parameters(delta)
+        client2.close()
+        fresh.stop()
+
+        handles = [e for e in tr.events() if e.name == "ps/handle_push"]
+        assert len(handles) == 2
+        assert {e.args["boot"] for e in handles} == {boot1, boot2}
+        assert all(e.trace_id == ctx.trace_id for e in handles)
+        pushes = [e for e in tr.events() if e.name == "ps/push"]
+        assert pushes and all(e.trace_id == ctx.trace_id for e in pushes)
+        # The handle span's parent is the client's ps/push span — the
+        # exact (trace_id, span_id) pair the wire header shipped.
+        push_ids = {e.span_id for e in pushes}
+        assert all(e.parent_id in push_ids for e in handles)
+        applies = [e for e in tr.events() if e.name == "ps/apply"]
+        assert len(applies) == 2
+        assert all(e.trace_id == ctx.trace_id for e in applies)
+    finally:
+        obs.disable_tracing()
 
 
 def test_prob_losses_match_logit_losses():

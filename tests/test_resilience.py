@@ -570,7 +570,8 @@ def test_comms_pipeline_push_retry_backoff_and_counter():
     from elephas_tpu import obs
     from elephas_tpu.engine.async_engine import _CommsPipeline
 
-    counter = obs.default_registry().counter("ps_push_retry_total")
+    counter = obs.default_registry().counter(
+        "ps_push_retry_total", labelnames=("worker",))
     before = counter.value
     sleeps = SleepRecorder()
     pushes = {"n": 0}

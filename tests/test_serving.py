@@ -259,6 +259,82 @@ def test_deadline_expires_in_queue(compiled):
     assert eng.result(busy, timeout_s=120).status == "completed"
 
 
+def test_rejections_and_evictions_land_in_flight_recorder(compiled):
+    """The anomalies the engine already detects — queue-full rejections,
+    deadline evictions (mid-decode AND in-queue) — each drop one
+    structured event into the flight recorder, with enough detail to
+    reconstruct what was rejected and where."""
+    from elephas_tpu import obs
+    from elephas_tpu.obs import FlightRecorder
+
+    recorder = FlightRecorder(capacity=32)
+    previous = obs.default_flight_recorder()
+    obs.set_default_flight_recorder(recorder)
+    try:
+        clock = FakeClock()
+        eng = _engine(compiled, max_slots=1, queue_depth=2, clock=clock)
+        busy = eng.submit([1, 2], max_new_tokens=50)
+        doomed = eng.submit([3, 4], max_new_tokens=5, timeout_s=2.0)
+        with pytest.raises(QueueFull):
+            eng.submit([5, 6], max_new_tokens=2)
+        (reject,) = recorder.events(kind="backpressure_reject")
+        assert reject.severity == "warn"
+        assert reject.detail["retry_after_s"] > 0
+        for _ in range(5):
+            clock.advance(1.0)
+            eng.step()
+        assert eng.result(doomed, timeout_s=10).status == "timeout"
+        (evict,) = recorder.events(kind="deadline_eviction")
+        assert evict.detail["where"] == "queue"
+        assert evict.detail["req_id"] == doomed
+        assert eng.result(busy, timeout_s=120).status == "completed"
+        # Mid-decode eviction carries the partial token count.
+        slow = eng.submit([7, 2], max_new_tokens=1000, timeout_s=5.0)
+        for _ in range(3):
+            clock.advance(1.0)
+            eng.step()
+        clock.advance(10.0)
+        eng.step()
+        assert eng.result(slow, timeout_s=10).status == "timeout"
+        evictions = recorder.events(kind="deadline_eviction")
+        assert evictions[-1].detail["where"] == "decode"
+        assert evictions[-1].detail["tokens"] > 0
+    finally:
+        obs.set_default_flight_recorder(previous)
+
+
+def test_engine_mount_ops_serves_live_routes(compiled):
+    """The serving frontend's ops endpoint: all five routes answered by
+    a live server, with /vars identifying the serving role and /healthz
+    reflecting live pool state."""
+    import urllib.request
+
+    def get_json(url):
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    eng = _engine(compiled, max_slots=3)
+    ops = eng.mount_ops(port=0)
+    try:
+        assert eng.mount_ops() is ops  # idempotent
+        doc = get_json(f"{ops.url}/vars")
+        assert doc["role"] == "serving" and doc["max_slots"] == 3
+        health = get_json(f"{ops.url}/healthz")
+        assert health["status"] == "ok"
+        assert health["pool_free"] == 3 and health["queue_depth"] == 0
+        rid = eng.submit([5, 3], max_new_tokens=4)
+        assert get_json(f"{ops.url}/healthz")["pool_free"] <= 3
+        eng.run_until_drained()
+        assert eng.result(rid, timeout_s=10).status == "completed"
+        assert "traceEvents" in get_json(f"{ops.url}/trace")
+        assert "counts_by_kind" in get_json(f"{ops.url}/flight")
+        with urllib.request.urlopen(f"{ops.url}/metrics", timeout=5) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+    finally:
+        eng.unmount_ops()
+    assert eng.ops is None
+
+
 # -- threaded frontend -----------------------------------------------------
 
 
