@@ -878,6 +878,11 @@ class AsyncTrainer:
                     if server is not None
                     else remote_client_factory()
                 )
+                if hasattr(client, "worker_id"):
+                    # Wire clients stamp pushes with the worker id so
+                    # the PS staleness ledger can attribute lag; the
+                    # in-process client has no wire frame to stamp.
+                    client.worker_id = f"w{global_index}"
                 per_worker_metrics[slot] = self._run_worker(
                     global_index, device, client, dataset, epochs, batch_size,
                     on_epoch_done=on_epoch_done,
@@ -1110,7 +1115,12 @@ class AsyncTrainer:
             address = f"{_dial_host(server.host)}:{server.port}"
 
             def client_factory(worker_id):
-                return make_client(mode, address, auth_key=auth_key)
+                client = make_client(mode, address, auth_key=auth_key)
+                # Stamp the wire identity: pushes then carry the
+                # worker id + trained-against version, which is what
+                # the PS staleness ledger keys its rows on.
+                client.worker_id = str(worker_id)
+                return client
 
         injector = None
         if self.fault_plan is not None:
@@ -1165,10 +1175,10 @@ class AsyncTrainer:
             ctx = obs.new_context() if tracer.enabled else None
             with obs.activate(ctx), tracer.span(
                     "async/unit", epoch=epoch, partition=part,
-                    worker=worker_id):
-                return unit_body(worker_id, client, unit)
+                    worker=worker_id) as usp:
+                return unit_body(worker_id, client, unit, usp)
 
-        def unit_body(worker_id: str, client, unit):
+        def unit_body(worker_id: str, client, unit, usp=None):
             epoch, part = unit
             device = device_for(worker_id)
             x, y, nb, usable = partition_rows(part)
@@ -1216,13 +1226,25 @@ class AsyncTrainer:
                 fetched = {
                     k: float(v) for k, v in jax.device_get(metrics).items()
                 }
+            delta_params = self._subtract(state0.params, new_state.params)
             client.update_parameters({
-                "params": self._subtract(state0.params, new_state.params),
+                "params": delta_params,
                 "batch_stats": self._subtract(
                     state0.batch_stats, new_state.batch_stats
                 ),
             })
             opt_states[worker_id] = new_state.opt_state
+            # Unit dynamics: the scan is already forced (metrics fetch
+            # above), so these host norms add one small transfer, not a
+            # pipeline stall. ``pulled`` is the host tree the unit
+            # trained FROM — the right denominator for effective step.
+            obs.record_unit_dynamics(
+                obs.default_registry(), worker_id,
+                loss=fetched.get("loss"),
+                delta_norm=obs.tree_norm(jax.device_get(delta_params)),
+                param_norm=obs.tree_norm(pulled["params"]),
+                span=usp,
+            )
             return fetched
 
         val_records: List[Optional[Dict[str, float]]] = [None] * epochs
@@ -1452,13 +1474,29 @@ class AsyncTrainer:
                 )
 
         def push_delta(before: TrainState, after: TrainState) -> None:
-            with tracer.span("async/push", worker=index):
+            with tracer.span("async/push", worker=index) as psp:
+                delta_params = self._subtract(before.params, after.params)
                 delta = {
-                    "params": self._subtract(before.params, after.params),
+                    "params": delta_params,
                     "batch_stats": self._subtract(
                         before.batch_stats, after.batch_stats
                     ),
                 }
+                if self.frequency == "epoch":
+                    # Dynamics only at epoch granularity: the norms
+                    # force a device fetch, and a per-step force would
+                    # serialize the batch pipeline (see run_unit's
+                    # device-fault note). Epoch units already forced
+                    # their scan before pushing, so this is one small
+                    # transfer, not a stall.
+                    obs.record_unit_dynamics(
+                        obs.default_registry(), f"w{index}",
+                        delta_norm=obs.tree_norm(
+                            jax.device_get(delta_params)),
+                        param_norm=obs.tree_norm(
+                            jax.device_get(before.params)),
+                        span=psp,
+                    )
                 if comms is None:
                     client.update_parameters(delta)
                     return
@@ -1535,6 +1573,10 @@ class AsyncTrainer:
                 # per-epoch val row must include the work it reports.
                 # Waits on pushes only, never the prefetched pull.
                 comms.flush()
+            # Per-epoch loss lands next to the push-side norms above so
+            # the worker's gauge row reads as one coherent unit.
+            obs.record_unit_dynamics(
+                obs.default_registry(), f"w{index}", loss=entry.get("loss"))
             entry["_retries"] = float(epoch_retries)
             epoch_metrics.append(entry)
             if on_epoch_done is not None:

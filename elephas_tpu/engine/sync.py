@@ -314,11 +314,26 @@ class SyncTrainer:
             t_ep = time.perf_counter()
             # The span covers dispatch AND the metrics fetch — the fetch
             # is where the host actually blocks on the epoch program.
-            with tracer.span("train/epoch", mode="sync", epoch=epoch):
+            with tracer.span("train/epoch", mode="sync", epoch=epoch) as esp:
+                prev_params = state.params
                 state, metrics = self._epoch_fn(state, xs, ys, jnp.int32(epoch))
                 metrics = {
                     k: float(v) for k, v in jax.device_get(metrics).items()
                 }
+                # Epoch dynamics: the metrics fetch above already forced
+                # the epoch program, so the delta norm costs one host
+                # transfer. Sync mode has one logical worker → the
+                # "driver" gauge row.
+                delta = jax.tree_util.tree_map(
+                    lambda a, b: a - b, prev_params, state.params
+                )
+                obs.record_unit_dynamics(
+                    obs.default_registry(),
+                    loss=metrics.get("loss"),
+                    delta_norm=obs.tree_norm(jax.device_get(delta)),
+                    param_norm=obs.tree_norm(jax.device_get(prev_params)),
+                    span=esp,
+                )
             epoch_hist.observe(time.perf_counter() - t_ep)
             if validation_data is not None:
                 # Eval in chunks of >=512 regardless of the (often tiny)

@@ -50,9 +50,22 @@ from elephas_tpu.obs.trace import (  # noqa: F401
     new_context,
 )
 from elephas_tpu.obs.flight import (  # noqa: F401
+    KINDS,
     NULL_FLIGHT_RECORDER,
     FlightEvent,
     FlightRecorder,
+)
+from elephas_tpu.obs.health import (  # noqa: F401
+    StalenessLedger,
+    record_staleness,
+    record_unit_dynamics,
+    tree_norm,
+)
+from elephas_tpu.obs.alerts import (  # noqa: F401
+    RULE_NAMES,
+    AlertEngine,
+    AlertRule,
+    default_rules,
 )
 
 _tracer: Tracer = NULL_TRACER

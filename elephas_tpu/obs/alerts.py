@@ -1,0 +1,260 @@
+"""SLO alert engine: declarative rules over ``MetricsRegistry`` snapshots.
+
+PR 6's flight recorder captures anomalies only where code *already*
+detects them; this module closes the loop by turning any registry
+metric into an anomaly source. A rule is declarative data — metric
+name, comparator + threshold, evaluation mode (instantaneous value or
+windowed rate), severity, and a burn count — and the engine evaluates
+the whole pack against ``registry.snapshot()`` on demand (every
+``/alerts`` scrape, every bench checkpoint): no background thread, an
+injectable clock, so seeded chaos runs replay the exact same ordered
+alert sequence.
+
+Matching is per *snapshot key*: a rule on ``ps_staleness_versions_p95``
+evaluates every labeled child (``...{worker="w1"}``) independently, so
+one rule yields per-worker breaches — that is how ``worker_lagging``
+singles out the straggler. Breaches emit FlightRecorder events (kinds
+from the registered ``flight.KINDS`` table), bump
+``alerts_fired_total{rule=}``, and append to an ordered ``fired``
+history the ``/alerts`` route serves.
+
+Rule *names* come from the ``RULE_NAMES`` registered-constant table —
+``scripts/lint_blocking.py`` rejects free-string names at ``AlertRule``
+call sites (``# kind-ok`` escapes) so dashboards and runbooks can key
+on a closed vocabulary.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from elephas_tpu.obs.flight import KINDS
+
+__all__ = ["AlertEngine", "AlertRule", "RULE_NAMES", "default_rules"]
+
+#: Registered rule-name vocabulary (see module docstring). Grow the
+#: table when adding a rule; don't invent names inline.
+RULE_NAMES = (
+    "staleness_p95_high",
+    "worker_lag_high",
+    "worker_expiry_rate",
+    "push_retry_rate",
+    "serving_itl_p99_high",
+)
+
+_PREDICATES = (">", "<")
+_MODES = ("value", "rate")
+
+
+class AlertRule:
+    """One declarative SLO rule.
+
+    ``metric`` names a snapshot key — matched exactly, or as the family
+    prefix of labeled keys (``metric{...}``). ``mode="value"`` compares
+    the key's current value; ``mode="rate"`` compares its per-second
+    rate of change over the trailing ``window_s`` (needs two evaluation
+    points inside the window before it can trip — counters only).
+    ``burn`` is how many *consecutive* evaluations must trip before the
+    breach fires; after firing, the rule re-arms once it evaluates
+    clean.
+    """
+
+    __slots__ = ("name", "metric", "predicate", "threshold", "window_s",
+                 "mode", "severity", "burn", "kind")
+
+    def __init__(self, name: str, metric: str, predicate: str,
+                 threshold: float, kind: str, window_s: float = 60.0,
+                 mode: str = "value", severity: str = "warn",
+                 burn: int = 1):
+        if predicate not in _PREDICATES:
+            raise ValueError(
+                f"predicate must be one of {_PREDICATES}, got {predicate!r}")
+        if mode not in _MODES:
+            raise ValueError(f"mode must be one of {_MODES}, got {mode!r}")
+        if kind not in KINDS:
+            raise ValueError(
+                f"alert kind must come from flight.KINDS, got {kind!r}")
+        if burn < 1:
+            raise ValueError(f"burn must be >= 1, got {burn}")
+        self.name = name
+        self.metric = metric
+        self.predicate = predicate
+        self.threshold = float(threshold)
+        self.window_s = float(window_s)
+        self.mode = mode
+        self.severity = severity
+        self.burn = int(burn)
+        self.kind = kind
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {s: getattr(self, s) for s in self.__slots__}
+
+    def __repr__(self):
+        return (f"AlertRule({self.name!r}, {self.metric!r} "
+                f"{self.predicate} {self.threshold}, mode={self.mode}, "
+                f"burn={self.burn}, kind={self.kind!r})")
+
+
+def default_rules() -> List[AlertRule]:
+    """The stock training-health pack. Thresholds are deliberately
+    conservative defaults — override by constructing the engine with an
+    explicit rule list."""
+    return [
+        # Applied-delta staleness: p95 of any worker's version lag.
+        AlertRule("staleness_p95_high", "ps_staleness_versions_p95",
+                  ">", 8.0, kind="staleness_spike", severity="warn"),
+        # A single worker far behind the fleet (same family, harder
+        # threshold): the bounded-staleness admission candidate.
+        AlertRule("worker_lag_high", "ps_staleness_versions_p95",
+                  ">", 32.0, kind="worker_lagging", severity="error"),
+        # Membership churn: liveness expiries per second.
+        AlertRule("worker_expiry_rate", "ps_worker_expired_total",
+                  ">", 0.1, kind="slo_breach", mode="rate",
+                  window_s=60.0, severity="warn", burn=2),
+        # Push retries per second (comms pipeline under partition/loss).
+        AlertRule("push_retry_rate", "ps_push_retry_total",
+                  ">", 0.5, kind="slo_breach", mode="rate",
+                  window_s=60.0, severity="warn", burn=2),
+        # Serving inter-token latency p99 (seconds).
+        AlertRule("serving_itl_p99_high", "serving_itl_seconds_p99",
+                  ">", 0.25, kind="slo_breach", severity="warn"),
+    ]
+
+
+class AlertEngine:
+    """Evaluates a rule pack against registry snapshots (thread-safe).
+
+    ``evaluate()`` is the only mutation point and is explicitly driven —
+    by the ``/alerts`` scrape, by bench checkpoints, by tests — on an
+    injectable clock, so there is nothing time-racy to make a seeded
+    chaos run non-deterministic. Missing metrics idle their rules (a
+    serving rule on a PS process never errors, it just never trips).
+    """
+
+    def __init__(self, registry=None, flight=None,
+                 rules: Optional[List[AlertRule]] = None,
+                 clock=time.monotonic):
+        self._registry = registry
+        self._flight = flight
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.clock = clock
+        self._lock = threading.Lock()
+        # (rule.name, key) → consecutive trip count / latched breach.
+        self._trips: Dict[Tuple[str, str], int] = {}
+        self._breached: Dict[Tuple[str, str], bool] = {}
+        # (rule.name, key) → deque[(t, value)] for rate rules.
+        self._points: Dict[Tuple[str, str], deque] = {}
+        self.fired: List[Dict[str, Any]] = []
+
+    # -- surface resolution (late, so process globals rebind) ---------------
+
+    def _get_registry(self):
+        if self._registry is not None:
+            return self._registry
+        from elephas_tpu import obs
+
+        return obs.default_registry()
+
+    def _get_flight(self):
+        if self._flight is not None:
+            return self._flight
+        from elephas_tpu import obs
+
+        return obs.default_flight_recorder()
+
+    # -- evaluation ---------------------------------------------------------
+
+    @staticmethod
+    def _match(metric: str, snap: Dict[str, float]) -> List[str]:
+        if metric in snap:
+            return [metric]
+        prefix = metric + "{"
+        return [k for k in snap if k.startswith(prefix)]
+
+    def _measure(self, rule: AlertRule, key: str, value: float,
+                 now: float) -> Optional[float]:
+        """The number the predicate sees: the value itself, or the
+        windowed per-second rate (None while under-sampled)."""
+        if rule.mode == "value":
+            return value
+        ring = self._points.setdefault((rule.name, key), deque())
+        ring.append((now, value))
+        while ring and now - ring[0][0] > rule.window_s:
+            ring.popleft()
+        if len(ring) < 2:
+            return None
+        t0, v0 = ring[0]
+        if now <= t0:
+            return None
+        return (value - v0) / (now - t0)
+
+    def evaluate(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One pass over every rule; returns alerts newly fired by THIS
+        pass (the full ordered history stays in ``self.fired``)."""
+        if now is None:
+            now = self.clock()
+        snap = self._get_registry().snapshot()
+        new_fired: List[Dict[str, Any]] = []
+        with self._lock:
+            for rule in self.rules:
+                for key in self._match(rule.metric, snap):
+                    measured = self._measure(rule, key, snap[key], now)
+                    if measured is None:
+                        continue
+                    tripped = (measured > rule.threshold
+                               if rule.predicate == ">"
+                               else measured < rule.threshold)
+                    state = (rule.name, key)
+                    if not tripped:
+                        self._trips[state] = 0
+                        self._breached[state] = False
+                        continue
+                    self._trips[state] = self._trips.get(state, 0) + 1
+                    if (self._trips[state] >= rule.burn
+                            and not self._breached.get(state)):
+                        self._breached[state] = True
+                        alert = {
+                            "rule": rule.name, "kind": rule.kind,
+                            "severity": rule.severity, "metric": key,
+                            "value": measured,
+                            "threshold": rule.threshold, "t": now,
+                        }
+                        self.fired.append(alert)
+                        new_fired.append(alert)
+        # Emit outside the engine lock: flight + registry take their own.
+        for alert in new_fired:
+            self._get_flight().note(
+                alert["kind"], alert["severity"], rule=alert["rule"],
+                metric=alert["metric"], value=alert["value"],
+                threshold=alert["threshold"])
+            self._get_registry().counter(
+                "alerts_fired_total",
+                help="SLO alert breaches fired, by rule",
+                labelnames=("rule",)).labels(rule=alert["rule"]).inc()
+        return new_fired
+
+    # -- read-out -----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready state — the ``/alerts`` opsd route serves this."""
+        with self._lock:
+            active = [
+                {"rule": name, "metric": key}
+                for (name, key), hot in sorted(self._breached.items())
+                if hot
+            ]
+            fired = list(self.fired)
+        return {
+            "rules": [r.to_dict() for r in self.rules],
+            "active": active,
+            "fired": fired,
+            "fired_kinds": [a["kind"] for a in fired],
+        }
+
+    def scrape(self) -> Dict[str, Any]:
+        """Evaluate, then snapshot — the one-call ops-route handler."""
+        self.evaluate()
+        return self.snapshot()

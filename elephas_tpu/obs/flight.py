@@ -34,10 +34,30 @@ from typing import Any, Dict, List, Optional
 
 from elephas_tpu.obs import trace as _trace
 
-__all__ = ["FlightEvent", "FlightRecorder", "NULL_FLIGHT_RECORDER"]
+__all__ = ["FlightEvent", "FlightRecorder", "KINDS", "NULL_FLIGHT_RECORDER"]
 
 #: Allowed severities, in increasing order of alarm.
 SEVERITIES = ("info", "warn", "error")
+
+#: The registered anomaly vocabulary. Every ``note()`` call site in the
+#: package must use a kind from this table (``scripts/lint_blocking.py``
+#: enforces it at the literal site; ``# kind-ok`` escapes) — free-string
+#: kinds fragment the ``counts_by_kind`` rollup and the alert engine's
+#: breach vocabulary. Grow the table, don't invent inline.
+KINDS = (
+    "retrace_storm",
+    "heartbeat_flap",
+    "worker_dead",
+    "stale_notmod",
+    "backpressure_reject",
+    "deadline_eviction",
+    "wal_restore",
+    "ps_kill",
+    # training-health alert kinds (obs/alerts.py)
+    "slo_breach",
+    "staleness_spike",
+    "worker_lagging",
+)
 
 
 class FlightEvent:
@@ -89,6 +109,7 @@ class FlightRecorder:
         self.dropped = 0
         self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
+        self._dropped_counter = None  # lazily bound on first overwrite
 
     def note(self, kind: str, severity: str = "warn",
              **detail) -> Optional[FlightEvent]:
@@ -109,9 +130,30 @@ class FlightRecorder:
                             ctx.trace_id if ctx is not None else None,
                             detail)
         with self._lock:
-            if len(self._events) == self._events.maxlen:
+            overwrote = len(self._events) == self._events.maxlen
+            if overwrote:
                 self.dropped += 1
             self._events.append(event)
+        if overwrote:
+            # Silent anomaly loss must itself be observable: mirror the
+            # tracer's truncation counter in the process registry so
+            # expose_text()/alert rules see it. Lazy-bound outside the
+            # ring lock; registry counters take their own lock.
+            counter = self._dropped_counter
+            if counter is None:
+                try:
+                    from elephas_tpu import obs
+
+                    counter = obs.default_registry().counter(
+                        "flight_dropped_total",
+                        help="flight-recorder events overwritten by the "
+                             "bounded ring before read-out",
+                    )
+                except Exception:
+                    counter = False  # registry unavailable: stop trying
+                self._dropped_counter = counter
+            if counter:
+                counter.inc()
         return event
 
     # -- read-out ----------------------------------------------------------

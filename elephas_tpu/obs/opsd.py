@@ -13,7 +13,11 @@ under load, the questions that today require attaching a debugger:
   ``scripts/trace_report.py --merge`` aligns across machines;
 - ``GET /vars``    — process identity and config (boot id, buffer
   version, bind address) for "which incarnation am I talking to";
-- ``GET /flight``  — the anomaly flight-recorder ring.
+- ``GET /flight``  — the anomaly flight-recorder ring;
+- ``GET /workers`` — the PS's per-worker staleness/contribution ledger
+  (``obs.health.StalenessLedger.snapshot``);
+- ``GET /alerts``  — the SLO alert engine's rules, active breaches, and
+  ordered fired history (each scrape runs one evaluation pass).
 
 Security: opsd binds **loopback by default** (``127.0.0.1``). It serves
 unauthenticated process internals — trace args can contain request ids
@@ -60,12 +64,19 @@ class OpsServer:
     health_fn: extra ``/healthz`` content (membership summary). If it
         raises, ``/healthz`` answers 500 — a health route that lies is
         worse than one that fails.
+    workers_fn: the ``/workers`` payload (a staleness-ledger snapshot);
+        the route answers an empty table when unset, so scrapers can
+        probe any process uniformly.
+    alerts_fn: the ``/alerts`` payload (an alert-engine scrape); answers
+        an empty rule pack when unset.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
                  registry=None, tracer=None, flight=None,
                  vars_fn: Optional[Callable[[], Dict]] = None,
-                 health_fn: Optional[Callable[[], Dict]] = None):
+                 health_fn: Optional[Callable[[], Dict]] = None,
+                 workers_fn: Optional[Callable[[], Dict]] = None,
+                 alerts_fn: Optional[Callable[[], Dict]] = None):
         self._requested_port = port
         self.host = host if host is not None else _default_bind_host()
         self._registry = registry
@@ -73,6 +84,8 @@ class OpsServer:
         self._flight = flight
         self._vars_fn = vars_fn
         self._health_fn = health_fn
+        self._workers_fn = workers_fn
+        self._alerts_fn = alerts_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_wall = None
@@ -143,6 +156,18 @@ class OpsServer:
                         self._send_json(200, doc)
                     elif self.path == "/flight":
                         self._send_json(200, ops._get_flight().snapshot())
+                    elif self.path == "/workers":
+                        doc = (ops._workers_fn() if ops._workers_fn
+                               is not None else
+                               {"workers": {}, "total_updates": 0,
+                                "unstamped_updates": 0})
+                        self._send_json(200, doc)
+                    elif self.path == "/alerts":
+                        doc = (ops._alerts_fn() if ops._alerts_fn
+                               is not None else
+                               {"rules": [], "active": [], "fired": [],
+                                "fired_kinds": []})
+                        self._send_json(200, doc)
                     else:
                         self._send_json(404, {"error": "not found",
                                               "path": self.path})

@@ -90,13 +90,20 @@ def _resolve_codec(codec: Optional[str]) -> str:
     return codec
 
 
-def _encode_push(delta, codec: str, quantize: Optional[str]):
+def _encode_push(delta, codec: str, quantize: Optional[str],
+                 seen_version: Optional[int] = None,
+                 worker: Optional[str] = None):
     """``(payload, codec_used)`` for one push. Structures the packed
     skeleton can't carry (custom pytree nodes) fall back to pickle —
-    the server accepts either on one endpoint."""
+    the server accepts either on one endpoint. ``seen_version``/
+    ``worker`` are the staleness stamps packed frames carry in-header
+    (pickle fallbacks lose them; the HTTP transport re-adds them as
+    request headers)."""
     if codec == "packed":
         try:
-            return wire.encode_tree(delta, quantize=quantize), "packed"
+            return wire.encode_tree(delta, quantize=quantize,
+                                    seen_version=seen_version,
+                                    worker=worker), "packed"
         except wire.WireFormatError:
             pass
     return wire.encode_pickle(delta), "pickle"
@@ -296,6 +303,10 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
         self.codec = _resolve_codec(codec)
         self.push_quantize = push_quantize
         self._pull_cache = _PullCache()
+        # Stable worker identity stamped onto pushes for the PS's
+        # staleness ledger; owners (the elastic pool's client factory)
+        # set it after construction. None → pushes go unstamped.
+        self.worker_id: Optional[str] = None
 
     def _connect_once(self, transfer_timeout: Optional[float] = None) -> http.client.HTTPConnection:
         conn = http.client.HTTPConnection(*self._addr, timeout=_CONNECT_TIMEOUT)
@@ -417,7 +428,11 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
     def update_parameters(self, delta) -> None:
         with _ps_span("push", "http") as sp:
             delta = jax.device_get(delta)
-            payload, codec = _encode_push(delta, self.codec, self.push_quantize)
+            seen = self._pull_cache.known_version()
+            payload, codec = _encode_push(delta, self.codec,
+                                          self.push_quantize,
+                                          seen_version=seen,
+                                          worker=self.worker_id)
             if isinstance(payload, wire.Frames):
                 # http.client needs one body buffer; the zero-copy chunk
                 # path is the socket transport's.
@@ -425,12 +440,19 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
             if sp:
                 sp.note(codec=codec, payload_bytes=len(payload),
                         quantize=self.push_quantize)
-            headers = None
+            headers = {}
             tc = _span_trace(sp)
             if tc is not None:
-                headers = {"X-Elephas-Trace": f"{tc.trace_id}-{tc.span_id}"}
+                headers["X-Elephas-Trace"] = f"{tc.trace_id}-{tc.span_id}"
+            # Staleness stamps ride as headers too, so a pickle-codec
+            # body (or a packed→pickle fallback) still declares what it
+            # trained against; the server prefers the in-frame stamps.
+            if seen is not None:
+                headers["X-Elephas-Seen-Version"] = str(seen)
+            if self.worker_id is not None:
+                headers["X-Elephas-Worker"] = str(self.worker_id)
             self._post("/update", payload, "update_parameters",
-                       headers=headers)
+                       headers=headers or None)
 
     def health(self) -> bool:
         """One non-retried probe of ``GET /health``, bounded end-to-end by
@@ -502,6 +524,8 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
         self.codec = _resolve_codec(codec)
         self.push_quantize = push_quantize
         self._pull_cache = _PullCache()
+        # See HttpClient.worker_id: staleness-ledger identity stamp.
+        self.worker_id: Optional[str] = None
         self._sock = None
         self._lock = threading.Lock()  # one in-flight request per connection
 
@@ -611,9 +635,10 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
                     # server recognizes a raw packed frame as a push by
                     # its magic. Unpackable structures ride the legacy
                     # ('u', delta) frame instead.
-                    frames = wire.encode_tree(delta,
-                                              quantize=self.push_quantize,
-                                              trace=tc)
+                    frames = wire.encode_tree(
+                        delta, quantize=self.push_quantize, trace=tc,
+                        seen_version=self._pull_cache.known_version(),
+                        worker=self.worker_id)
                     frame, codec, nbytes = frames, "packed", frames.nbytes
                 except wire.WireFormatError:
                     pass

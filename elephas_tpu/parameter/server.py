@@ -68,6 +68,29 @@ def _ps_counters(transport: str):
     )
 
 
+def _note_staleness(ledger, worker, seen_version, nbytes, buffer) -> Optional[int]:
+    """Measure one push's version lag and feed the health surfaces.
+
+    Called immediately BEFORE ``apply_delta``: lag = the buffer's live
+    version minus the version the worker trained against (clamped at 0 —
+    a racing hogwild apply can only make the live version newer). Frames
+    without a ``seen_version`` stamp (legacy peers) are counted as
+    unstamped coverage, not measured. Returns the lag (None when
+    unstamped) so handle spans can tag it."""
+    lag = None
+    if seen_version is not None:
+        try:
+            lag = max(0, int(buffer.version) - int(seen_version))
+        except (TypeError, ValueError):
+            lag = None
+    from elephas_tpu.obs.health import record_staleness
+
+    record_staleness(ledger, worker, lag, nbytes=nbytes,
+                     version=seen_version,
+                     registry=obs.default_registry())
+    return lag
+
+
 def _parse_trace_header(raw: Optional[str]):
     """``X-Elephas-Trace: <trace_id>-<span_id>`` → TraceContext | None.
     Malformed values are dropped, never fatal — tracing must not be able
@@ -291,7 +314,7 @@ class _ObservableServerMixin:
 
     Expects the host class to set ``tracer`` (override or None),
     ``ops_port``, ``ops``, ``flight_dump``, ``_wal_dir``, ``buffer``,
-    ``detector``, ``boot``, ``host``, ``port``.
+    ``detector``, ``boot``, ``host``, ``port``, ``ledger``, ``alerts``.
     """
 
     def _tracer(self):
@@ -304,6 +327,7 @@ class _ObservableServerMixin:
         from elephas_tpu.obs.opsd import OpsServer
 
         buffer, detector, boot = self.buffer, self.detector, self.boot
+        ledger, alerts = self.ledger, self.alerts
         self.ops = OpsServer(
             port=self.ops_port,
             tracer=self.tracer,  # None → live process default
@@ -311,6 +335,8 @@ class _ObservableServerMixin:
                              "transport": transport,
                              "ps_host": self.host, "ps_port": self.port},
             health_fn=lambda: {"membership": detector.membership()},
+            workers_fn=ledger.snapshot,
+            alerts_fn=alerts.scrape,
         ).start()
 
     def _unmount_ops(self) -> None:
@@ -394,6 +420,11 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         self.tracer = tracer
         self.ops_port = ops_port
         self.ops = None
+        # Training-health surfaces: the per-worker staleness/contribution
+        # ledger the push handlers feed (opsd /workers) and the SLO alert
+        # engine evaluated on every /alerts scrape.
+        self.ledger = obs.StalenessLedger()
+        self.alerts = obs.AlertEngine()
         self.flight_dump: Optional[str] = None
         self._wal_dir = wal_dir
         self._httpd = None
@@ -410,6 +441,7 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         cache = self._cache = _SnapshotCache(buffer, boot=boot)
         cache_hits, bytes_tx, bytes_rx = _ps_counters("http")
         tracer_of = self._tracer
+        ledger = self.ledger
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # silence per-request stderr spam
@@ -535,13 +567,29 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
                     # Trace context: the HTTP header, or (packed bodies)
                     # the frame's own "tc" header. Decoding is zero-copy,
                     # so doing it before the handle span costs ~nothing.
-                    tree, body_tc = wire.decode_payload_traced(body)
+                    tree, body_tc, seen, worker = wire.decode_push(body)
+                    # Pickle bodies carry their staleness stamps as
+                    # request headers instead of in-frame.
+                    if seen is None:
+                        raw_seen = self.headers.get("X-Elephas-Seen-Version")
+                        if raw_seen is not None:
+                            try:
+                                seen = int(raw_seen)
+                            except ValueError:
+                                seen = None
+                    if worker is None:
+                        worker = self.headers.get("X-Elephas-Worker")
                     ctx = (_parse_trace_header(
                                self.headers.get("X-Elephas-Trace"))
                            or _as_trace_ctx(body_tc))
                     tracer = tracer_of()
                     with obs.activate(ctx), tracer.span(
-                            "ps/handle_push", boot=boot, transport="http"):
+                            "ps/handle_push", boot=boot,
+                            transport="http") as hsp:
+                        lag = _note_staleness(ledger, worker, seen,
+                                              len(body), buffer)
+                        if hsp and lag is not None:
+                            hsp.note(staleness=lag, worker=worker)
                         with tracer.span("ps/apply", boot=boot):
                             # The buffer-lock + apply + WAL durability
                             # window — the "lock" phase in the per-unit
@@ -623,6 +671,7 @@ class _SocketHandler(socketserver.BaseRequestHandler):
         detector = self.server.detector  # type: ignore[attr-defined]
         wal_writer = self.server.wal_writer  # type: ignore[attr-defined]
         tracer_of = self.server.tracer_of  # type: ignore[attr-defined]
+        ledger = self.server.ledger  # type: ignore[attr-defined]
         cache_hits, bytes_tx, bytes_rx = _ps_counters("socket")
         try:
             while True:
@@ -652,10 +701,15 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                 if isinstance(obj, (bytes, bytearray, memoryview)):
                     mv = memoryview(obj)
                     bytes_rx.inc(mv.nbytes)
-                    tree, tc = wire.decode_payload_traced(mv)
+                    tree, tc, seen, worker = wire.decode_push(mv)
                     tracer = tracer_of()
                     with obs.activate(_as_trace_ctx(tc)), tracer.span(
-                            "ps/handle_push", boot=boot, transport="socket"):
+                            "ps/handle_push", boot=boot,
+                            transport="socket") as hsp:
+                        lag = _note_staleness(ledger, worker, seen,
+                                              mv.nbytes, buffer)
+                        if hsp and lag is not None:
+                            hsp.note(staleness=lag, worker=worker)
                         with tracer.span("ps/apply", boot=boot):
                             buffer.apply_delta(tree)
                             if wal_writer is not None:
@@ -695,6 +749,9 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                     tracer = tracer_of()
                     with obs.activate(ctx), tracer.span(
                             "ps/handle_push", boot=boot, transport="socket"):
+                        # Legacy pickle frame: no staleness stamps — the
+                        # ledger counts it as unstamped coverage.
+                        _note_staleness(ledger, None, None, 0, buffer)
                         with tracer.span("ps/apply", boot=boot):
                             buffer.apply_delta(payload)
                             if wal_writer is not None:
@@ -809,6 +866,9 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         self.tracer = tracer
         self.ops_port = ops_port
         self.ops = None
+        # See HttpServer: staleness ledger + SLO alert engine.
+        self.ledger = obs.StalenessLedger()
+        self.alerts = obs.AlertEngine()
         self.flight_dump: Optional[str] = None
         self._wal_dir = wal_dir
         self._server = None
@@ -825,6 +885,7 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         self._server.detector = self.detector  # type: ignore[attr-defined]
         self._server.wal_writer = self.wal_writer  # type: ignore[attr-defined]
         self._server.tracer_of = self._tracer  # type: ignore[attr-defined]
+        self._server.ledger = self.ledger  # type: ignore[attr-defined]
         if self.port == 0:
             self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)

@@ -62,6 +62,7 @@ __all__ = [
     "decode_payload",
     "decode_payload_traced",
     "decode_pickle",
+    "decode_push",
     "encode_not_modified",
     "encode_pickle",
     "encode_tree",
@@ -123,16 +124,26 @@ class DecodedTree:
 
     ``trace`` (observability layer): the sender's active
     ``(trace_id, span_id)`` pair, when it shipped one — the PS handler
-    adopts it so its handle span joins the client's causal tree."""
+    adopts it so its handle span joins the client's causal tree.
 
-    __slots__ = ("tree", "version", "boot", "trace")
+    ``seen_version``/``worker`` (training-health layer): on a *push*
+    frame, the buffer version the worker trained its delta against and
+    the worker's stable id — the PS's staleness accounting subtracts
+    ``seen_version`` from its live version at apply time. Both optional,
+    both absent from the header JSON when the sender didn't stamp them."""
+
+    __slots__ = ("tree", "version", "boot", "trace", "seen_version", "worker")
 
     def __init__(self, tree, version: Optional[int], boot: Optional[str] = None,
-                 trace: Optional[Tuple[str, str]] = None):
+                 trace: Optional[Tuple[str, str]] = None,
+                 seen_version: Optional[int] = None,
+                 worker: Optional[str] = None):
         self.tree = tree
         self.version = version
         self.boot = boot
         self.trace = trace
+        self.seen_version = seen_version
+        self.worker = worker
 
 
 def is_packed(buf) -> bool:
@@ -234,7 +245,9 @@ def _leaf_chunk(arr: np.ndarray):
 def encode_tree(tree, version: Optional[int] = None,
                 quantize: Optional[str] = None,
                 boot: Optional[str] = None,
-                trace: Optional[Tuple[str, str]] = None) -> Frames:
+                trace: Optional[Tuple[str, str]] = None,
+                seen_version: Optional[int] = None,
+                worker: Optional[str] = None) -> Frames:
     """Encode a pytree of arrays/scalars into a packed frame.
 
     ``boot``: the serving PS's boot id, carried in the header so clients
@@ -248,6 +261,11 @@ def encode_tree(tree, version: Optional[int] = None,
     ``"tc"`` in the header so the receiving PS's handle span joins the
     sender's trace. Like ``boot``, omitted entirely when None: frames
     from untraced processes stay byte-identical with older peers.
+
+    ``seen_version``/``worker``: push-side staleness stamps, carried as
+    ``"sv"``/``"wk"`` under the same omitted-when-None contract — the PS
+    measures version lag only on frames that declare what they trained
+    against, and legacy frames stay byte-identical.
     """
     leaves: List[Any] = []
     skeleton = _build_skeleton(tree, leaves)
@@ -278,6 +296,10 @@ def encode_tree(tree, version: Optional[int] = None,
         meta["boot"] = str(boot)
     if trace is not None:
         meta["tc"] = [str(trace[0]), str(trace[1])]
+    if seen_version is not None:
+        meta["sv"] = int(seen_version)
+    if worker is not None:
+        meta["wk"] = str(worker)
     header = json.dumps(meta, separators=(",", ":")).encode()
     # Pad the header with spaces (JSON-transparent) so the payload
     # region starts 64B-aligned relative to the frame start.
@@ -371,7 +393,8 @@ def decode(buf, expect_treedef=None):
             )
     tc = header.get("tc")
     return DecodedTree(tree, header.get("ver"), header.get("boot"),
-                       tuple(tc) if tc else None)
+                       tuple(tc) if tc else None,
+                       header.get("sv"), header.get("wk"))
 
 
 def decode_payload(buf, expect_treedef=None):
@@ -402,3 +425,16 @@ def decode_payload_traced(buf, expect_treedef=None):
             raise WireFormatError("not-modified frame where a tree was expected")
         return out.tree, out.trace
     return decode_pickle(buf), None
+
+
+def decode_push(buf, expect_treedef=None):
+    """``decode_payload`` for the PS push handlers: surfaces the sender's
+    trace context AND staleness stamps as ``(tree, trace, seen_version,
+    worker)``. Legacy pickle bodies decode with every stamp ``None`` —
+    staleness simply isn't measured for peers that don't declare it."""
+    if is_packed(buf):
+        out = decode(buf, expect_treedef=expect_treedef)
+        if isinstance(out, NotModified):
+            raise WireFormatError("not-modified frame where a tree was expected")
+        return out.tree, out.trace, out.seen_version, out.worker
+    return decode_pickle(buf), None, None, None

@@ -484,15 +484,21 @@ class InferenceEngine:
     def mount_ops(self, port: int = 0, host: Optional[str] = None):
         """Mount a live introspection endpoint (``obs.opsd``) for this
         engine: ``/metrics``, ``/healthz`` (+ queue/pool summary),
-        ``/trace``, ``/vars``, ``/flight``. Loopback-bound by default;
-        port 0 picks a free one (read ``engine.ops.port``). Idempotent.
+        ``/trace``, ``/vars``, ``/flight``, ``/alerts`` (stock SLO rule
+        pack — its serving ITL rule reads the registry mirror
+        ``ServingMetrics`` feeds). Loopback-bound by default; port 0
+        picks a free one (read ``engine.ops.port``). Idempotent.
         """
         if self.ops is not None:
             return self.ops
+        from elephas_tpu import obs
         from elephas_tpu.obs.opsd import OpsServer
 
+        if getattr(self, "_alert_engine", None) is None:
+            self._alert_engine = obs.AlertEngine()
         self.ops = OpsServer(
             port=port, host=host, tracer=self.tracer,
+            alerts_fn=self._alert_engine.scrape,
             vars_fn=lambda: {
                 "role": "serving",
                 "max_slots": self.pool.max_slots,

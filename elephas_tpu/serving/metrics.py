@@ -59,6 +59,13 @@ class ServingMetrics:
         self.histograms = {k: Histogram(k) for k in _LATENCY_KEYS}
         self._last_overlap: Optional[float] = None
         self._t0: Optional[float] = None
+        # Lazy process-registry mirror of the ITL distribution: the SLO
+        # alert pack's serving rule reads ``serving_itl_seconds_p99``
+        # from registry snapshots, which the private per-engine
+        # histograms above never reach. Bound on first finish; False
+        # latches "registry unavailable" so a broken import can't tax
+        # every request.
+        self._registry_itl = None
 
     def reset(self) -> None:
         """Zero every in-memory aggregate (the sink, if any, keeps its
@@ -101,6 +108,19 @@ class ServingMetrics:
         if result.itl_s_avg is not None:
             self.itl_s.append(result.itl_s_avg)
             self.histograms["itl_s"].observe(result.itl_s_avg)
+            hist = self._registry_itl
+            if hist is None:
+                try:
+                    from elephas_tpu import obs
+                    hist = obs.default_registry().histogram(
+                        "serving_itl_seconds",
+                        help="per-request mean inter-token latency",
+                    )
+                except Exception:
+                    hist = False
+                self._registry_itl = hist
+            if hist:
+                hist.observe(result.itl_s_avg)
         if self.sink is not None:
             self.sink.log(
                 self.steps,

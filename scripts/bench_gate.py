@@ -79,6 +79,12 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             ("wall_s", "lower", 1.00),
             ("mttr_max_s", "lower", 1.00),
             ("final_loss", "lower", 1.00),
+            # Training-health row (--health): applied-delta version lag
+            # must not blow up. Lag is a small integer with real
+            # scheduling noise at bench scale, so the tolerance is the
+            # loosest in the table — it catches order-of-magnitude
+            # staleness blowups, not ±1 version jitter.
+            ("staleness_p95", "lower", 2.00),
         ],
     ),
 }

@@ -40,10 +40,19 @@ and prints the per-unit queue/wire/lock/train critical-path table.
 Because the wire codec propagates ``(trace_id, span_id)``, the worker
 and PS dumps join on trace id exactly as true multi-process dumps do.
 
+``--health`` appends a ``{"scenario": "health"}`` row: a seeded
+kill-worker fit measured through the PS's ``StalenessLedger`` (the
+per-worker contribution table, exact lag percentiles, bucketed lag
+histogram) plus the deterministic fake-clock ``alert_ladder`` sequence
+— same ``--seed`` → same ordered alert kinds, pinned by
+``test_chaos.py`` and gated by ``bench_gate.py``'s ``staleness_p95``
+rule.
+
 Importable without a TPU; tier-1-sized defaults finish in ~1 min on
 CPU. Usage:
     python scripts/chaos_bench.py [--epochs 4] [--outage 4.0]
-        [--n 256] [--out BENCH_CHAOS.json] [--trace] [--trace-dir D]
+        [--n 256] [--out BENCH_CHAOS.json] [--health] [--seed 11]
+        [--trace] [--trace-dir D]
 """
 
 from __future__ import annotations
@@ -196,6 +205,148 @@ def scenario_partition(x, y, epochs):
                       trace_digest=hex(plan.trace_digest()))
 
 
+def alert_ladder(seed: int):
+    """Deterministic alert replay: drive a PRIVATE registry/flight/
+    engine stack (injected clock, seeded lag draws) through a staleness
+    ramp, a straggler burst, and an expiry-counter burn, and return the
+    ordered kinds that fired. Same seed → byte-identical sequence —
+    ``test_chaos.py`` pins it, and the ``--health`` row commits it.
+
+    The ladder exercises every evaluation mode the stock pack uses:
+    value rules on labeled histogram percentiles (per-worker matching),
+    and a windowed rate rule with ``burn=2`` (two consecutive trips
+    before it fires)."""
+    from elephas_tpu import obs
+    from elephas_tpu.obs.health import record_staleness
+
+    reg = obs.MetricsRegistry()
+    engine = obs.AlertEngine(registry=reg, flight=obs.FlightRecorder(),
+                             clock=lambda: 0.0)
+    rng = np.random.default_rng(seed)
+    # t=0: healthy lags on w0 — nothing fires.
+    for lag in rng.integers(0, 3, size=32):
+        record_staleness(None, "w0", int(lag), registry=reg)
+    engine.evaluate(now=0.0)
+    # t=10: w0's p95 ramps past 8 → staleness_spike.
+    for lag in rng.integers(10, 14, size=64):
+        record_staleness(None, "w0", int(lag), registry=reg)
+    engine.evaluate(now=10.0)
+    # t=20: w1 appears far behind the fleet (>32) → its key trips BOTH
+    # staleness rules, in rule-pack order: staleness_spike, then
+    # worker_lagging.
+    for lag in rng.integers(40, 48, size=64):
+        record_staleness(None, "w1", int(lag), registry=reg)
+    engine.evaluate(now=20.0)
+    # t=30..50: expiry-counter burst at ~3/s (rule threshold 0.1/s,
+    # burn=2): first rated point trips at t=40, fires at t=50.
+    expired = reg.counter("ps_worker_expired_total",
+                          help="probe counter for the alert ladder")
+    engine.evaluate(now=30.0)
+    expired.inc(30)
+    engine.evaluate(now=40.0)
+    expired.inc(30)
+    engine.evaluate(now=50.0)
+    return [a["kind"] for a in engine.fired]
+
+
+def staleness_probe(seed: int, steps: int = 24):
+    """Deterministic wire-level staleness ladder against a real socket
+    PS: per step, a probe client pulls (pinning the version it "trained
+    against"), a feeder client advances the server a seeded number of
+    versions with re-pulled zero deltas, then the probe pushes — so the
+    probe's applied lag is EXACT by construction. The ledger's wire-side
+    measurement is asserted equal to the constructed ladder, which is
+    the end-to-end proof the ``sv`` stamp survives encode→socket→apply.
+
+    Returns ``(lags, probe_row)``: the seeded lag list (the measured
+    distribution) and the probe's ledger row."""
+    import jax
+
+    from elephas_tpu.parameter.client import make_client
+    from elephas_tpu.parameter.server import make_server
+
+    net = _build_net()
+    store = {"params": net.params, "batch_stats": net.batch_stats}
+    zero = jax.tree_util.tree_map(
+        lambda a: np.zeros_like(np.asarray(a)), jax.device_get(store))
+    server = make_server("socket", store, port=0)
+    server.start()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        probe = make_client("socket", addr)
+        probe.worker_id = "probe"
+        feeder = make_client("socket", addr)
+        feeder.worker_id = "feeder"
+        lags = [int(v) for v in
+                np.random.default_rng(seed).integers(0, 12, size=steps)]
+        for lag in lags:
+            probe.get_parameters()
+            for _ in range(lag):
+                # Re-pull before each feeder push so the feeder itself
+                # contributes lag-0 samples, not a growing tail.
+                feeder.get_parameters()
+                feeder.update_parameters(zero)
+            probe.update_parameters(zero)
+        row = server.ledger.snapshot()["workers"]["probe"]
+        assert row["updates"] == steps, row
+        assert row["lag_sum"] == sum(lags), (row, lags)
+        probe.close()
+        feeder.close()
+        return lags, row
+    finally:
+        server.stop()
+
+
+def scenario_health(x, y, epochs, seed: int = 11):
+    """Training-health probe (``--health``): a seeded kill-worker chaos
+    fit measured through the PS's staleness ledger (the per-worker
+    contribution table), the deterministic ``staleness_probe`` ladder
+    (exact wire-measured lag distribution — the gated ``staleness_p95``),
+    and the ``alert_ladder`` sequence for the same seed."""
+    from elephas_tpu.obs.health import STALENESS_BUCKETS
+    from elephas_tpu.resilience import FaultPlan
+
+    plan = FaultPlan(seed=seed, kill_worker_at={"w1": 1})
+    trainer = _build_trainer(fault_plan=plan)
+    captured = {}
+
+    def chaos(trainer):
+        # Only capture the live server: its ledger outlives the fit's
+        # teardown, so the table below is read after join, race-free.
+        while trainer._elastic_server is None:
+            time.sleep(0.005)
+        captured["ledger"] = trainer._elastic_server.ledger
+        return {}
+
+    history, stats, wall, _ = _run_fit(trainer, x, y, epochs, chaos=chaos)
+    led = captured["ledger"].snapshot()
+    workers = {
+        w: {k: row[k] for k in ("updates", "lag_mean", "lag_max", "bytes")}
+        for w, row in sorted(led["workers"].items())
+    }
+    lags, probe_row = staleness_probe(seed)
+    arr = np.asarray(lags)
+    hist, lo = {}, -1
+    for bound in STALENESS_BUCKETS:
+        hist[f"le_{bound}"] = int(((arr > lo) & (arr <= bound)).sum())
+        lo = bound
+    hist[f"gt_{STALENESS_BUCKETS[-1]}"] = int((arr > lo).sum())
+    return _stats_row(
+        "health", history, stats, wall,
+        seed=seed,
+        staleness_p50=round(float(np.percentile(arr, 50)), 3),
+        staleness_p95=round(float(np.percentile(arr, 95)), 3),
+        staleness_p99=round(float(np.percentile(arr, 99)), 3),
+        staleness_hist=hist,
+        probe_updates=probe_row["updates"],
+        probe_lag_max=probe_row["lag_max"],
+        fit_staleness_p95=led["lag_p95"],
+        unstamped_updates=led["unstamped_updates"],
+        workers=workers,
+        alert_seq=alert_ladder(seed),
+    )
+
+
 def export_role_dumps(tracer, outdir, prefix="chaos_trace"):
     """Split the in-process span ring into the per-role dumps a real
     deployment would collect from each process's ``/trace`` route:
@@ -227,6 +378,14 @@ def main(argv=None):
                     help="kill_ps hold-down seconds (keep above the "
                          "~2.8s client retry budget so failures surface)")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--health", action="store_true",
+                    help="append the training-health row: per-worker "
+                         "staleness ledger table, lag histogram + "
+                         "percentiles, and the seeded deterministic "
+                         "alert-ladder sequence")
+    ap.add_argument("--seed", type=int, default=11,
+                    help="--health fault-plan + alert-ladder seed (same "
+                         "seed → same ordered alert kinds)")
     ap.add_argument("--trace", action="store_true",
                     help="record the run under the obs tracer and emit "
                          "per-role dumps + a merged trace with the "
@@ -249,6 +408,8 @@ def main(argv=None):
     rows.append(scenario_kill_ps(x, y, args.epochs, args.outage))
     rows.append(scenario_kill_worker(x, y, args.epochs))
     rows.append(scenario_partition(x, y, args.epochs))
+    if args.health:
+        rows.append(scenario_health(x, y, args.epochs, seed=args.seed))
 
     anchor = rows[1]["final_loss"]
     for row in rows[2:]:

@@ -69,6 +69,18 @@ are unconstrained (no unit convention fits them all). Escape pragma:
 ``# metric-ok``, for deliberate deviations (e.g. a bridge exporting a
 foreign system's names verbatim).
 
+A sixth rule closes the ANOMALY/ALERT VOCABULARY: FlightRecorder event
+kinds and SLO alert rule names are what dashboards, runbooks, and the
+alert engine's rule pack key on, so both come from registered-constant
+tables — ``obs.flight.KINDS`` and ``obs.alerts.RULE_NAMES``. A string
+literal passed positionally to ``.note("…")`` (the span ``note`` takes
+kwargs only, so a positional string is uniquely the flight recorder's)
+or as ``AlertRule("…")``'s name / ``kind=`` that isn't in its table is
+flagged, as is any f-string there. The vocabularies are read from the
+defining modules' ASTs — the lint never imports the package. Grow the
+table to add a kind; ``# kind-ok`` escapes deliberate test-local vocab.
+This rule also scans ``scripts/``.
+
 Wired into tier-1 via ``tests/test_lint_blocking.py``; also runnable
 standalone: ``python scripts/lint_blocking.py`` (exit 1 on violations).
 """
@@ -78,7 +90,7 @@ from __future__ import annotations
 import ast
 import sys
 from pathlib import Path
-from typing import List, NamedTuple
+from typing import List, NamedTuple, Tuple
 
 PRAGMA = "host-ok"
 SANCTIONED = "host_sync.py"
@@ -86,6 +98,7 @@ PICKLE_PRAGMA = "pickle-ok"
 PICKLE_SANCTIONED = "wire.py"
 CLOCK_PRAGMA = "clock-ok"
 METRIC_PRAGMA = "metric-ok"
+KIND_PRAGMA = "kind-ok"
 _NUMPY_NAMES = ("np", "numpy")
 _CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
 _PICKLE_ATTRS = ("dumps", "loads", "dump", "load")
@@ -100,6 +113,14 @@ class Violation(NamedTuple):
     domain: str = "serving"
 
     def __str__(self):
+        if self.domain == "kind":
+            return (
+                f"{self.path}:{self.lineno}: unregistered {self.call} — "
+                f"FlightRecorder kinds come from obs.flight.KINDS and "
+                f"alert rule names from obs.alerts.RULE_NAMES (grow the "
+                f"table, never invent the string inline; `# {KIND_PRAGMA}` "
+                f"for deliberate local vocab)\n    {self.line.strip()}"
+            )
         if self.domain == "metric":
             return (
                 f"{self.path}:{self.lineno}: metric name {self.call} "
@@ -320,6 +341,85 @@ def lint_metric_package(root: Path) -> List[Violation]:
     return out
 
 
+def load_registered_vocab(pkg_root: Path):
+    """``(KINDS, RULE_NAMES)`` read straight from the defining modules'
+    ASTs — pure-literal tuples by construction, so ``literal_eval``
+    suffices and the lint never has to import the package (which would
+    drag in jax)."""
+    out = {}
+    for fname, const in (("flight.py", "KINDS"), ("alerts.py", "RULE_NAMES")):
+        tree = ast.parse((pkg_root / "obs" / fname).read_text())
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == const
+                    for t in node.targets):
+                out[const] = tuple(ast.literal_eval(node.value))
+    return out["KINDS"], out["RULE_NAMES"]
+
+
+def _kind_call_names(node: ast.Call, kinds, rule_names) -> List[str]:
+    """Unregistered-vocabulary findings for one call. A positional
+    string to ``.note(…)`` is uniquely a FlightRecorder kind (span
+    ``note`` is kwargs-only); ``AlertRule(…)`` is judged on its name
+    (first positional) and ``kind=`` keyword. Strings that arrive
+    through variables pass — the literal is linted at its definition."""
+    fn = node.func
+    found = []
+
+    def judge(arg, vocab, where):
+        if isinstance(arg, ast.JoinedStr):
+            found.append(f"<f-string> {where}")
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value not in vocab:
+            found.append(f"`{arg.value}` {where}")
+
+    if isinstance(fn, ast.Attribute) and fn.attr == "note" and node.args:
+        judge(node.args[0], kinds, "kind in .note()")
+    callee = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    if callee == "AlertRule":
+        if node.args:
+            judge(node.args[0], rule_names, "rule name in AlertRule()")
+        for kw in node.keywords:
+            if kw.arg == "kind":
+                judge(kw.value, kinds, "kind in AlertRule()")
+    return found
+
+
+def lint_kind_file(path: Path, kinds, rule_names) -> List[Violation]:
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        names = _kind_call_names(node, kinds, rule_names)
+        if not names:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if KIND_PRAGMA in line:
+            continue
+        for name in names:
+            out.append(Violation(str(path), node.lineno, name, line,
+                                 domain="kind"))
+    return out
+
+
+def lint_kind_package(pkg_root: Path,
+                      extra_roots: Tuple[Path, ...] = ()) -> List[Violation]:
+    """Lint the whole package tree plus any extra roots (``scripts/``) —
+    the vocabulary is process-global, so no file is exempt."""
+    kinds, rule_names = load_registered_vocab(pkg_root)
+    out = []
+    paths = sorted(pkg_root.rglob("*.py"))
+    for root in extra_roots:
+        paths.extend(sorted(root.glob("*.py")))
+    for path in paths:
+        out.extend(lint_kind_file(path, kinds, rule_names))
+    return out
+
+
 def main(argv: List[str] | None = None) -> List[Violation]:
     args = list(sys.argv[1:] if argv is None else argv)
     pkg_root = Path(__file__).resolve().parent.parent / "elephas_tpu"
@@ -329,6 +429,8 @@ def main(argv: List[str] | None = None) -> List[Violation]:
         violations.extend(lint_pickle_package(pkg_root / "parameter"))
         violations.extend(lint_resilience_package(pkg_root / "resilience"))
         violations.extend(lint_metric_package(pkg_root))
+        violations.extend(lint_kind_package(
+            pkg_root, extra_roots=(Path(__file__).resolve().parent,)))
     for v in violations:
         print(v)
     if not violations:

@@ -158,3 +158,25 @@ def test_committed_artifacts_self_compare():
     assert pairs, "no committed bench artifacts found"
     verdict = bg.gate(pairs)
     assert verdict["verdict"] == "pass", verdict["failures"]
+
+
+def test_staleness_rule_gates_health_row():
+    """The --health chaos row's staleness_p95 is "lower" with the
+    table's loosest tolerance (2.00 → 3× ceiling): order-of-magnitude
+    blowups fail, scheduling jitter does not; rows without the metric
+    (every non-health scenario) are untouched by the rule."""
+    base = [{"scenario": "health", "completed_units": 6,
+             "staleness_p95": 4.0},
+            {"scenario": "kill_worker", "completed_units": 6}]
+    jitter = bg.compare(base, [
+        {"scenario": "health", "completed_units": 6, "staleness_p95": 11.0},
+        {"scenario": "kill_worker", "completed_units": 6}], "chaos")
+    assert all(c["ok"] for c in jitter)
+    blowup = bg.compare(base, [
+        {"scenario": "health", "completed_units": 6, "staleness_p95": 40.0},
+        {"scenario": "kill_worker", "completed_units": 6}], "chaos")
+    failed = [c for c in blowup if not c["ok"]]
+    assert [(c["key"], c["metric"]) for c in failed] == [
+        ("health", "staleness_p95")]
+    by = _checks_by_metric(bg.compare(base, base, "chaos"))
+    assert ("kill_worker", "staleness_p95") not in by  # absent → not gated

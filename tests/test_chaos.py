@@ -226,3 +226,26 @@ def test_partition_window_is_ridden_out(blobs_xy, baseline_loss):
         assert abs(history["loss"][-1] - baseline_loss) < 0.02
         digests.append(plan.trace_digest())
     assert digests[0] == digests[1]
+
+
+def test_health_alert_sequence_is_replay_stable():
+    """Satellite pin: the seeded alert ladder fires the same kinds in
+    the same order on every run — the BENCH_CHAOS ``--health`` row's
+    ``alert_seq`` is a deterministic artifact, not a timing accident."""
+    import scripts.chaos_bench as chaos_bench
+
+    runs = [chaos_bench.alert_ladder(seed=11) for _ in range(2)]
+    assert runs[0] == runs[1]
+    assert runs[0] == ["staleness_spike", "staleness_spike",
+                       "worker_lagging", "slo_breach"]
+
+
+def test_health_staleness_probe_lag_is_exact(blobs_xy):
+    """The wire staleness probe induces a known lag per push; the PS
+    ledger must account for every version of it exactly."""
+    import scripts.chaos_bench as chaos_bench
+
+    lags, row = chaos_bench.staleness_probe(seed=11, steps=8)
+    assert row["updates"] == 8
+    assert row["lag_sum"] == int(sum(lags))
+    assert row["lag_max"] == int(max(lags))

@@ -271,6 +271,69 @@ def test_metric_lint_passes_sanctioned_shapes(tmp_path):
     assert lint.lint_metric_file(ok) == []
 
 
+def test_kind_vocabulary_is_registered():
+    """THE vocabulary invariant: every FlightRecorder kind literal and
+    AlertRule name/kind literal across the package AND scripts/ comes
+    from the registered tables (obs.flight.KINDS / obs.alerts.RULE_NAMES)
+    — a free-string kind fails tier-1 here."""
+    pkg_root = Path(lint.__file__).resolve().parent.parent / "elephas_tpu"
+    assert pkg_root.is_dir()
+    violations = lint.lint_kind_package(
+        pkg_root, extra_roots=(Path(lint.__file__).resolve().parent,))
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_kind_lint_catches_each_form(tmp_path):
+    bad = tmp_path / "bad_kinds.py"
+    bad.write_text(textwrap.dedent("""
+        def f(flight, name):
+            flight.note("totally_new_thing", "warn")
+            flight.note(f"kind_{name}", "warn")
+            AlertRule("my_rule", "m", ">", 1.0, kind="slo_breach")
+            AlertRule("staleness_p95_high", "m", ">", 1.0, kind="made_up")
+    """))
+    kinds, rule_names = lint.load_registered_vocab(
+        Path(lint.__file__).resolve().parent.parent / "elephas_tpu")
+    calls = sorted(v.call for v in lint.lint_kind_file(bad, kinds, rule_names))
+    assert calls == [
+        "<f-string> kind in .note()",
+        "`made_up` kind in AlertRule()",
+        "`my_rule` rule name in AlertRule()",
+        "`totally_new_thing` kind in .note()",
+    ]
+    msg = str(lint.lint_kind_file(bad, kinds, rule_names)[0])
+    assert "obs.flight.KINDS" in msg and "RULE_NAMES" in msg
+
+
+def test_kind_lint_passes_sanctioned_shapes(tmp_path):
+    """Registered literals, variable kinds (linted at their definition),
+    kwargs-only span notes, and the ``# kind-ok`` pragma all pass."""
+    ok = tmp_path / "ok_kinds.py"
+    ok.write_text(textwrap.dedent("""
+        def f(flight, span, kind):
+            flight.note("slo_breach", "warn", rule="staleness_p95_high")
+            flight.note(kind, "warn")
+            span.note(worker="w0", staleness=3)
+            AlertRule("worker_lag_high", "m", ">", 32.0,
+                      kind="worker_lagging")
+            flight.note("test_only", "info")  # kind-ok: local test vocab
+    """))
+    kinds, rule_names = lint.load_registered_vocab(
+        Path(lint.__file__).resolve().parent.parent / "elephas_tpu")
+    assert lint.lint_kind_file(ok, kinds, rule_names) == []
+
+
+def test_registered_vocab_matches_runtime_tables():
+    """The AST-read tables equal the importable constants, so the lint's
+    idea of the vocabulary can never drift from the engine's."""
+    from elephas_tpu import obs
+
+    kinds, rule_names = lint.load_registered_vocab(
+        Path(lint.__file__).resolve().parent.parent / "elephas_tpu")
+    assert kinds == obs.KINDS
+    assert rule_names == obs.RULE_NAMES
+
+
 def test_cli_reports_clean(capsys):
     assert lint.main([]) == []
     assert "clean" in capsys.readouterr().out

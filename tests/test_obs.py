@@ -645,3 +645,234 @@ def test_serving_metrics_percentiles():
     assert s["ttft_s_p50"] == pytest.approx(0.105, rel=0.5)
     m.reset()
     assert m.summary()["ttft_s_p50"] is None
+
+
+# -- training health: staleness ledger + dynamics --------------------------
+
+
+def test_staleness_ledger_rows_and_percentiles():
+    import numpy as np  # noqa: F401  (parity with the apply-site types)
+
+    from elephas_tpu.obs import StalenessLedger
+
+    led = StalenessLedger(clock=FakeClock(42.0))
+    for lag in (0, 1, 1, 2, 8):
+        led.record("w0", lag, nbytes=100, version=10 + lag)
+    led.record("w1", None)  # unstamped legacy frame: counted, not measured
+    snap = led.snapshot()
+    row = snap["workers"]["w0"]
+    assert row["updates"] == 5 and row["lag_sum"] == 12
+    assert row["lag_max"] == 8 and row["bytes"] == 500
+    assert row["last_seen_s"] == 42.0 and row["last_seen_version"] == 18
+    assert row["lag_mean"] == pytest.approx(2.4)
+    assert snap["unstamped_updates"] == 1
+    assert snap["total_updates"] == 5
+    assert snap["lag_p50"] == 1.0
+    assert led.lag_percentile(1.0) == 8
+    assert led.samples() == [0, 1, 1, 2, 8]
+
+
+def test_staleness_ledger_window_bounds_memory():
+    from elephas_tpu.obs import StalenessLedger
+
+    led = StalenessLedger(sample_capacity=4)
+    for lag in range(10):
+        led.record("w0", lag)
+    assert led.samples() == [6, 7, 8, 9]  # window dropped the oldest
+    snap = led.snapshot()
+    assert snap["window_samples"] == 4
+    assert snap["workers"]["w0"]["lag_sum"] == sum(range(10))  # exact forever
+
+
+def test_record_staleness_feeds_ledger_and_labeled_histogram():
+    from elephas_tpu.obs import StalenessLedger
+    from elephas_tpu.obs.health import record_staleness
+
+    reg = MetricsRegistry()
+    led = StalenessLedger()
+    record_staleness(led, "w3", 5, nbytes=10, version=9, registry=reg)
+    record_staleness(led, None, None, registry=reg)  # no distribution point
+    snap = reg.snapshot()
+    assert snap['ps_staleness_versions_count{worker="w3"}'] == 1
+    assert snap['ps_staleness_versions_sum{worker="w3"}'] == 5
+    assert led.snapshot()["unstamped_updates"] == 1
+
+
+def test_tree_norm_walks_nested_host_trees():
+    import numpy as np
+
+    tree = {"a": np.asarray([3.0, 4.0]),
+            "b": [np.asarray([0.0], np.float32), None],
+            "c": (np.asarray([0], np.int32),)}
+    assert obs.tree_norm(tree) == pytest.approx(5.0)
+    assert obs.tree_norm({}) == 0.0
+
+
+def test_record_unit_dynamics_gauges_and_span_tags():
+    reg = MetricsRegistry()
+    recorded = obs.record_unit_dynamics(reg, "w0", loss=0.5,
+                                        delta_norm=1.0, param_norm=4.0)
+    assert recorded == {"unit_loss": 0.5, "delta_norm": 1.0,
+                        "effective_step": 0.25}
+    snap = reg.snapshot()
+    assert snap['train_unit_loss{worker="w0"}'] == 0.5
+    assert snap['train_delta_norm{worker="w0"}'] == 1.0
+    assert snap['train_effective_step{worker="w0"}'] == 0.25
+    # No worker → the "driver" row (sync trainer's single lane).
+    obs.record_unit_dynamics(reg, loss=0.25)
+    assert reg.snapshot()['train_unit_loss{worker="driver"}'] == 0.25
+    # The live unit span gets the same numbers as args.
+    tracer = Tracer(annotate_device=False)
+    with tracer.span("async/unit", worker="w0") as sp:
+        obs.record_unit_dynamics(reg, "w0", loss=1.5, span=sp, epoch=2)
+    event = tracer.events()[-1]
+    assert event.args["unit_loss"] == 1.5 and event.args["epoch"] == 2
+
+
+# -- SLO alert engine ------------------------------------------------------
+
+
+def test_alert_rule_validates_inputs():
+    from elephas_tpu.obs import AlertRule
+
+    with pytest.raises(ValueError, match="KINDS"):
+        AlertRule("staleness_p95_high", "m", ">", 1.0, kind="nope")
+    with pytest.raises(ValueError, match="predicate"):
+        AlertRule("staleness_p95_high", "m", "!=", 1.0, kind="slo_breach")
+    with pytest.raises(ValueError, match="mode"):
+        AlertRule("staleness_p95_high", "m", ">", 1.0, kind="slo_breach",
+                  mode="derivative")
+    with pytest.raises(ValueError, match="burn"):
+        AlertRule("staleness_p95_high", "m", ">", 1.0, kind="slo_breach",
+                  burn=0)
+
+
+def test_default_rule_pack_uses_registered_vocab():
+    rules = obs.default_rules()
+    assert {r.name for r in rules} == set(obs.RULE_NAMES)
+    assert {r.kind for r in rules} <= set(obs.KINDS)
+
+
+def test_alert_engine_value_rule_fires_latches_and_rearms():
+    from elephas_tpu.obs import AlertEngine, AlertRule
+
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    rule = AlertRule("staleness_p95_high", "g", ">", 5.0,
+                     kind="staleness_spike")
+    engine = AlertEngine(registry=reg, flight=fr, rules=[rule],
+                         clock=FakeClock(0.0))
+    g = reg.gauge("g", help="probe")
+    g.set(3.0)
+    assert engine.evaluate(now=0.0) == []
+    g.set(9.0)
+    fired = engine.evaluate(now=1.0)
+    assert [a["kind"] for a in fired] == ["staleness_spike"]
+    assert engine.evaluate(now=2.0) == []  # latched: no re-fire while hot
+    g.set(1.0)
+    engine.evaluate(now=3.0)  # clean pass re-arms
+    g.set(9.0)
+    assert [a["kind"] for a in engine.evaluate(now=4.0)] == [
+        "staleness_spike"]
+    # Breaches land in the flight ring and the ordered history.
+    assert fr.snapshot()["counts_by_kind"]["staleness_spike"] == 2
+    assert [a["kind"] for a in engine.fired] == ["staleness_spike"] * 2
+    assert reg.snapshot()[
+        'alerts_fired_total{rule="staleness_p95_high"}'] == 2
+
+
+def test_alert_engine_rate_rule_burns_before_firing():
+    from elephas_tpu.obs import AlertEngine, AlertRule
+
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    rule = AlertRule("worker_expiry_rate", "c_total", ">", 0.5,
+                     kind="slo_breach", mode="rate", window_s=60.0, burn=2)
+    engine = AlertEngine(registry=reg, flight=fr, rules=[rule],
+                         clock=FakeClock(0.0))
+    c = reg.counter("c_total", help="probe")
+    assert engine.evaluate(now=0.0) == []  # one point: under-sampled
+    c.inc(100)
+    assert engine.evaluate(now=10.0) == []  # rate 10/s: trip 1 of burn 2
+    c.inc(100)
+    fired = engine.evaluate(now=20.0)
+    assert [a["kind"] for a in fired] == ["slo_breach"]
+    assert fired[0]["rule"] == "worker_expiry_rate"
+
+
+def test_alert_engine_matches_labeled_children_per_worker():
+    """One rule on a family prefix evaluates every labeled child — that
+    is how worker_lagging singles out the straggler without a rule per
+    worker."""
+    from elephas_tpu.obs import AlertEngine, AlertRule
+    from elephas_tpu.obs.health import record_staleness
+
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    rule = AlertRule("worker_lag_high", "ps_staleness_versions_p95",
+                     ">", 32.0, kind="worker_lagging", severity="error")
+    engine = AlertEngine(registry=reg, flight=fr, rules=[rule],
+                         clock=FakeClock(0.0))
+    for _ in range(8):
+        record_staleness(None, "w0", 1, registry=reg)
+        record_staleness(None, "w1", 60, registry=reg)
+    fired = engine.evaluate(now=0.0)
+    assert len(fired) == 1
+    assert fired[0]["metric"].endswith('worker="w1"}')
+    assert fired[0]["severity"] == "error"
+    snap = engine.snapshot()
+    assert snap["active"] == [{"rule": "worker_lag_high",
+                               "metric": fired[0]["metric"]}]
+    assert snap["fired_kinds"] == ["worker_lagging"]
+
+
+def test_alert_engine_scrape_is_evaluate_plus_snapshot():
+    from elephas_tpu.obs import AlertEngine, AlertRule
+
+    reg = MetricsRegistry()
+    rule = AlertRule("serving_itl_p99_high", "g", ">", 1.0,
+                     kind="slo_breach")
+    engine = AlertEngine(registry=reg, flight=FlightRecorder(),
+                         rules=[rule], clock=FakeClock(7.0))
+    reg.gauge("g", help="probe").set(2.0)
+    doc = engine.scrape()
+    assert doc["fired_kinds"] == ["slo_breach"]
+    assert doc["rules"][0]["name"] == "serving_itl_p99_high"
+    assert json.dumps(doc)  # the /alerts route body is JSON-ready
+
+
+# -- flight recorder drop accounting ---------------------------------------
+
+
+def test_flight_dropped_surfaces_in_snapshot_and_registry():
+    """Overwritten anomalies stay visible: ``dropped`` + ring capacity
+    in the /flight payload, flight_dropped_total in the process
+    registry's exposition."""
+    fr = FlightRecorder(capacity=2)
+    for i in range(5):
+        fr.note("heartbeat_flap", "warn", i=i)
+    snap = fr.snapshot()
+    assert snap["capacity"] == 2
+    assert snap["dropped"] == 3
+    assert len(snap["events"]) == 2
+    text = obs.default_registry().expose_text()
+    assert "flight_dropped_total" in text
+
+
+def test_serving_metrics_mirror_itl_into_process_registry():
+    """The SLO pack's serving rule reads serving_itl_seconds_p99 from
+    registry snapshots — record_finish must feed the mirror histogram."""
+    from elephas_tpu.serving.metrics import ServingMetrics
+    from elephas_tpu.serving.scheduler import GenerationResult
+
+    before = obs.default_registry().snapshot().get(
+        "serving_itl_seconds_count", 0)
+    m = ServingMetrics(clock=FakeClock())
+    m.record_finish(
+        GenerationResult(req_id=1, tokens=[1], status="completed",
+                         prompt_tokens=1, ttft_s=0.01, itl_s_avg=0.02),
+        queue_depth=0, active=1,
+    )
+    snap = obs.default_registry().snapshot()
+    assert snap["serving_itl_seconds_count"] == before + 1
+    assert "serving_itl_seconds_p99" in snap

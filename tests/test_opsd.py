@@ -165,3 +165,121 @@ def test_ps_server_mounts_ops_and_unmounts_on_stop():
     assert server.ops is None
     with pytest.raises(urllib.error.URLError):
         _get(f"{url}/healthz", timeout=0.5)
+
+
+def test_workers_and_alerts_routes_default_empty(ops):
+    """The routes exist even before anything wires a ledger or an
+    engine: empty JSON shells, not 404s — scrapers can deploy first."""
+    status, doc = _get_json(f"{ops.url}/workers")
+    assert status == 200
+    assert doc == {"workers": {}, "total_updates": 0,
+                   "unstamped_updates": 0}
+    status, doc = _get_json(f"{ops.url}/alerts")
+    assert status == 200
+    assert doc == {"rules": [], "active": [], "fired": [],
+                   "fired_kinds": []}
+
+
+def test_ps_mount_serves_staleness_ledger_and_alerts():
+    """A mounted PS feeds /workers from its apply-site ledger and
+    /alerts from its default rule pack; a stamped wire client shows up
+    as a per-worker row with real version lag."""
+    from elephas_tpu.parameter.server import SocketServer
+
+    params = {"dense": {"w": np.ones((4, 4), np.float32)}}
+    server = SocketServer(params, lock=True, port=0, ops_port=0)
+    server.start()
+    try:
+        url = server.ops.url
+        client = server.client()
+        client.worker_id = "w9"
+        client.get_parameters()
+        delta = {"dense": {"w": np.full((4, 4), 0.25, np.float32)}}
+        client.update_parameters(delta)  # lag 0: trained against v0
+        client.update_parameters(delta)  # lag >= 1: never re-pulled
+        client.close()
+
+        status, doc = _get_json(f"{url}/workers")
+        assert status == 200
+        row = doc["workers"]["w9"]
+        assert row["updates"] == 2
+        assert row["lag_max"] >= 1
+        assert row["bytes"] > 0
+        assert doc["total_updates"] == 2
+
+        status, doc = _get_json(f"{url}/alerts")
+        assert status == 200
+        names = [r["name"] for r in doc["rules"]]
+        assert "staleness_p95_high" in names
+        assert set(names) == set(obs.RULE_NAMES)
+        # The engine reads the PROCESS registry (other tests' workers
+        # may legitimately breach there) — w9's two quiet pushes must
+        # not, and anything fired uses registered vocabulary.
+        assert not any('worker="w9"' in a.get("metric", "")
+                       for a in doc["fired"])
+        assert set(doc["fired_kinds"]) <= set(obs.KINDS)
+    finally:
+        server.stop()
+
+
+def test_routes_survive_concurrent_scrapes_while_registry_mutates():
+    """Satellite: hammer /metrics, /workers and /alerts from parallel
+    scrapers while a writer thread mutates the registry, the ledger and
+    the counters underneath them. Every response must be 200 and
+    well-formed — no handler exceptions, no torn bodies."""
+    import threading
+
+    from elephas_tpu.obs import AlertEngine, StalenessLedger
+    from elephas_tpu.obs.health import record_staleness
+
+    registry = MetricsRegistry()
+    ledger = StalenessLedger()
+    flight = FlightRecorder(capacity=16)
+    engine = AlertEngine(registry=registry, flight=flight,
+                         clock=lambda: 0.0)
+    server = OpsServer(port=0, registry=registry,
+                       tracer=Tracer(annotate_device=False, enabled=False),
+                       flight=flight,
+                       workers_fn=ledger.snapshot,
+                       alerts_fn=engine.scrape)
+    server.start()
+    errors = []
+    stop = threading.Event()
+
+    def writer():
+        c = registry.counter("ps_push_total", help="pushes",
+                             labelnames=("worker",))
+        i = 0
+        while not stop.is_set():
+            record_staleness(ledger, f"w{i % 4}", i % 7, nbytes=64,
+                             version=i, registry=registry)
+            c.labels(worker=f"w{i % 4}").inc()
+            i += 1
+
+    def scraper(route):
+        for _ in range(25):
+            try:
+                status, ctype, body = _get(f"{server.url}{route}")
+                assert status == 200, (route, status, body)
+                if ctype.startswith("application/json"):
+                    json.loads(body)
+                else:
+                    body.decode()
+            except Exception as err:  # noqa: BLE001 - collected for assert
+                errors.append((route, repr(err)))
+
+    wt = threading.Thread(target=writer, daemon=True)
+    wt.start()
+    threads = [threading.Thread(target=scraper, args=(route,), daemon=True)
+               for route in ("/metrics", "/workers", "/alerts") * 3]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        stop.set()
+        wt.join(timeout=5)
+        server.stop()
+    assert errors == []
