@@ -282,6 +282,7 @@ class AsyncTrainer:
         ps_wal_dir: Optional[str] = None,
         wal_every: int = 1,
         ps_recovery_grace: float = 15.0,
+        ps_ops_port: Optional[int] = None,
     ):
         """``pipelined_comms``: run each worker's PS traffic on a
         background comms thread (``_CommsPipeline``) — pushes become
@@ -372,6 +373,13 @@ class AsyncTrainer:
         self.ps_wal_dir = ps_wal_dir
         self.wal_every = wal_every
         self.ps_recovery_grace = ps_recovery_grace
+        # ops_port for any wire PS this fit spawns (0 = free port; read
+        # server.ops.port off the elastic chaos handle), plus this
+        # worker process's own mountable ops endpoint (mount_ops()).
+        self.ps_ops_port = ps_ops_port
+        self.ops = None
+        self._ops_history = None
+        self._ops_alerts = None
         # Chaos-harness handles, live during an elastic fit: the current
         # server object (tests kill/replace it) and the worker pool
         # (tests join late workers / inspect membership).
@@ -555,6 +563,49 @@ class AsyncTrainer:
 
     # -------------------------------------------------------------------------
 
+    def mount_ops(self, port: int = 0, host: Optional[str] = None):
+        """Mount a live introspection endpoint for THIS worker process
+        (role ``worker``): ``/metrics`` serves the process registry the
+        training loop already feeds, ``/history`` its sampled rings,
+        ``/profile`` device capture + memory watermarks. A fleet
+        aggregator polls this next to the PS's own endpoint so trainer
+        and server sides of an outage are visible together. Loopback by
+        default; idempotent; ``unmount_ops()`` tears it down."""
+        if self.ops is not None:
+            return self.ops
+        from elephas_tpu import obs
+        from elephas_tpu.obs.devprof import record_device_memory
+        from elephas_tpu.obs.opsd import OpsServer
+
+        try:
+            worker_id = f"w{jax.process_index()}"
+        except Exception:
+            worker_id = "w0"
+        self._ops_history = obs.HistorySampler(
+            extra_fn=record_device_memory).start()
+        self._ops_alerts = obs.AlertEngine()
+        self.ops = OpsServer(
+            port=port, host=host, role="worker", worker_id=worker_id,
+            alerts_fn=self._ops_alerts.scrape,
+            history=self._ops_history,
+            vars_fn=lambda: {
+                "role": "worker",
+                "worker_id": worker_id,
+                "parameter_server_mode": self.parameter_server_mode,
+                "frequency": self.frequency,
+                "elastic": self.elastic,
+            },
+        ).start()
+        return self.ops
+
+    def unmount_ops(self) -> None:
+        if self.ops is not None:
+            self.ops.stop()
+            self.ops = None
+        if self._ops_history is not None:
+            self._ops_history.stop()
+            self._ops_history = None
+
     def fit(
         self,
         dataset,
@@ -613,6 +664,7 @@ class AsyncTrainer:
                 auth_key=bytes.fromhex(env_key) if env_key else None,
                 wal_dir=self.ps_wal_dir,
                 wal_every=self.wal_every,
+                ops_port=self.ps_ops_port,
             )
             server.start()
         else:
@@ -649,6 +701,7 @@ class AsyncTrainer:
                     auth_key=auth_key,
                     wal_dir=self.ps_wal_dir,
                     wal_every=self.wal_every,
+                    ops_port=self.ps_ops_port,
                 )
                 server.start()
             if server is not None:
@@ -1098,6 +1151,7 @@ class AsyncTrainer:
             auth_key=auth_key,
             wal_dir=self.ps_wal_dir,
             wal_every=self.wal_every,
+            ops_port=self.ps_ops_port,
         )
         server.start()
         self._elastic_server = server

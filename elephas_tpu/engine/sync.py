@@ -128,6 +128,8 @@ class SyncTrainer:
         self.frequency = frequency
         self.autotune = autotune
         self.autotune_choice = None
+        self.ops = None
+        self._ops_history = None
         self.n_shards = mesh.shape[DATA_AXIS]
         self._train_step = make_train_step(compiled)
         self._eval_step = make_eval_step(compiled)
@@ -146,6 +148,47 @@ class SyncTrainer:
             self._predict_step, out_shardings=replicated_sharding(mesh),
             compiler_options=opts,
         )
+
+    # -- observability ---------------------------------------------------------
+
+    def mount_ops(self, port: int = 0, host: Optional[str] = None):
+        """Mount a live introspection endpoint for this (single-process,
+        SPMD) trainer — role ``worker``: ``/metrics`` serves the process
+        registry the compiled-step counters feed, ``/history`` its
+        sampled rings, ``/profile`` device capture + per-device memory
+        watermarks (the hook the ROADMAP's real-chip runs need).
+        Loopback by default; idempotent."""
+        if self.ops is not None:
+            return self.ops
+        from elephas_tpu.obs.devprof import record_device_memory
+        from elephas_tpu.obs.history import HistorySampler
+        from elephas_tpu.obs.opsd import OpsServer
+
+        try:
+            worker_id = f"w{jax.process_index()}"
+        except Exception:
+            worker_id = "w0"
+        self._ops_history = HistorySampler(
+            extra_fn=record_device_memory).start()
+        self.ops = OpsServer(
+            port=port, host=host, role="worker", worker_id=worker_id,
+            history=self._ops_history,
+            vars_fn=lambda: {
+                "role": "worker",
+                "worker_id": worker_id,
+                "frequency": self.frequency,
+                "n_shards": self.n_shards,
+            },
+        ).start()
+        return self.ops
+
+    def unmount_ops(self) -> None:
+        if self.ops is not None:
+            self.ops.stop()
+            self.ops = None
+        if self._ops_history is not None:
+            self._ops_history.stop()
+            self._ops_history = None
 
     # -- compiled bodies -------------------------------------------------------
 

@@ -67,6 +67,21 @@ from elephas_tpu.obs.alerts import (  # noqa: F401
     AlertRule,
     default_rules,
 )
+from elephas_tpu.obs.history import (  # noqa: F401
+    DEFAULT_SAMPLE_PREFIXES,
+    HistoryRing,
+    HistorySampler,
+)
+from elephas_tpu.obs.devprof import (  # noqa: F401
+    DeviceProfiler,
+    device_memory_snapshot,
+    record_device_memory,
+)
+from elephas_tpu.obs.fleet import (  # noqa: F401
+    FleetAggregator,
+    ProcessRegistry,
+    parse_prometheus_text,
+)
 
 _tracer: Tracer = NULL_TRACER
 _registry = MetricsRegistry()

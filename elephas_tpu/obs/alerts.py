@@ -28,10 +28,10 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from elephas_tpu.obs.flight import KINDS
+from elephas_tpu.obs.history import HistoryRing
 
 __all__ = ["AlertEngine", "AlertRule", "RULE_NAMES", "default_rules"]
 
@@ -145,8 +145,10 @@ class AlertEngine:
         # (rule.name, key) → consecutive trip count / latched breach.
         self._trips: Dict[Tuple[str, str], int] = {}
         self._breached: Dict[Tuple[str, str], bool] = {}
-        # (rule.name, key) → deque[(t, value)] for rate rules.
-        self._points: Dict[Tuple[str, str], deque] = {}
+        # (rule.name, key) → HistoryRing for rate rules: the same
+        # windowed-rate substrate /history serves, instead of a private
+        # two-point-delta bookkeeping scheme.
+        self._points: Dict[Tuple[str, str], HistoryRing] = {}
         self.fired: List[Dict[str, Any]] = []
 
     # -- surface resolution (late, so process globals rebind) ---------------
@@ -180,16 +182,15 @@ class AlertEngine:
         windowed per-second rate (None while under-sampled)."""
         if rule.mode == "value":
             return value
-        ring = self._points.setdefault((rule.name, key), deque())
-        ring.append((now, value))
-        while ring and now - ring[0][0] > rule.window_s:
-            ring.popleft()
-        if len(ring) < 2:
-            return None
-        t0, v0 = ring[0]
-        if now <= t0:
-            return None
-        return (value - v0) / (now - t0)
+        ring = self._points.get((rule.name, key))
+        if ring is None:
+            # 512 slots at the 60 s default window tolerates ~8 Hz
+            # evaluation before the oldest in-window point can rotate
+            # out — far denser than any scrape loop in this repo.
+            ring = self._points.setdefault((rule.name, key),
+                                           HistoryRing(capacity=512))
+        ring.push(now, value)
+        return ring.rate(rule.window_s, now=now)
 
     def evaluate(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
         """One pass over every rule; returns alerts newly fired by THIS

@@ -1,0 +1,189 @@
+"""On-demand device profiling: trace capture + memory watermarks.
+
+The ROADMAP's "serving on a real chip" item needs two hooks preinstalled
+before any TPU shows up, and both are useful on CPU today:
+
+- ``DeviceProfiler`` — a start/stop bridge over ``jax.profiler``'s
+  trace capture, guarded by a non-blocking capture lock (XLA allows one
+  active capture per process; a second ``start`` answers *busy* instead
+  of corrupting the first). Dumps land next to the WAL when mounted on
+  a PS (same placement as the kill-path flight dump — one directory
+  holds everything needed to debug an incarnation), or in a temp dir
+  otherwise. The opsd ``/profile`` route drives it remotely:
+  ``?action=start`` / ``?action=stop`` / bare GET for status.
+- ``device_memory_snapshot`` / ``record_device_memory`` — per-device
+  live-buffer byte watermarks surfaced as ``device_mem_bytes{device=}``
+  gauges and sampled into the history ring. Backends differ wildly
+  here: TPU/GPU runtimes answer ``device.memory_stats()``, CPU usually
+  answers ``None`` — so the probe tries ``memory_stats``, falls back to
+  summing ``live_buffers()`` sizes, and reports nothing rather than
+  guessing. Every probe is exception-guarded: a broken runtime query
+  must never take down the sampler thread driving it.
+
+The profiler's starter/stopper are injectable so tests exercise the
+lock protocol and dump lifecycle without importing jax at all.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+import warnings
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "DeviceProfiler",
+    "device_memory_snapshot",
+    "record_device_memory",
+]
+
+
+def _jax_start_trace(out_dir: str) -> None:
+    import jax
+
+    jax.profiler.start_trace(out_dir)
+
+
+def _jax_stop_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+class DeviceProfiler:
+    """Start/stop trace capture with a capture lock (see module doc).
+
+    ``start`` answers ``{"status": "started", ...}`` or
+    ``{"status": "busy", ...}`` — never raises for the already-capturing
+    case, because the remote caller poking ``/profile?action=start``
+    twice deserves a 409-shaped answer, not a stack trace. Runtime
+    failures from the underlying profiler *are* surfaced (as
+    ``{"status": "error", ...}``) so a misconfigured backend is visible.
+    """
+
+    def __init__(self, out_dir: Optional[str] = None,
+                 starter: Callable[[str], None] = _jax_start_trace,
+                 stopper: Callable[[], None] = _jax_stop_trace,
+                 clock=time.monotonic):
+        self.out_dir = out_dir
+        self._starter = starter
+        self._stopper = stopper
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._capturing = False
+        self._capture_dir: Optional[str] = None
+        self._started_at: Optional[float] = None
+        self.captures = 0  # completed start→stop cycles
+
+    def _resolve_dir(self, out_dir: Optional[str]) -> str:
+        d = out_dir or self.out_dir
+        if d is None:
+            d = os.path.join(tempfile.gettempdir(), "elephas-profile")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def start(self, out_dir: Optional[str] = None) -> Dict[str, object]:
+        with self._lock:
+            if self._capturing:
+                return {"status": "busy", "dir": self._capture_dir,
+                        "since_s": self.clock() - self._started_at}
+            d = self._resolve_dir(out_dir)
+            try:
+                self._starter(d)
+            except Exception as exc:
+                return {"status": "error", "error": repr(exc), "dir": d}
+            self._capturing = True
+            self._capture_dir = d
+            self._started_at = self.clock()
+            return {"status": "started", "dir": d}
+
+    def stop(self) -> Dict[str, object]:
+        with self._lock:
+            if not self._capturing:
+                return {"status": "idle"}
+            d, t0 = self._capture_dir, self._started_at
+            try:
+                self._stopper()
+            except Exception as exc:
+                # The capture is unrecoverable either way; release the
+                # lock so a retry can start fresh.
+                self._capturing = False
+                self._capture_dir = None
+                self._started_at = None
+                return {"status": "error", "error": repr(exc), "dir": d}
+            self._capturing = False
+            self._capture_dir = None
+            self._started_at = None
+            self.captures += 1
+            return {"status": "stopped", "dir": d,
+                    "duration_s": self.clock() - t0}
+
+    def status(self) -> Dict[str, object]:
+        with self._lock:
+            doc: Dict[str, object] = {
+                "capturing": self._capturing,
+                "captures": self.captures,
+                "dir": self._capture_dir or self.out_dir,
+            }
+            if self._capturing:
+                doc["since_s"] = self.clock() - self._started_at
+            return doc
+
+
+def device_memory_snapshot() -> Dict[str, int]:
+    """Per-device live bytes: ``{"TFRT_CPU_0": 123456, ...}``.
+
+    Tries ``device.memory_stats()["bytes_in_use"]`` (TPU/GPU runtimes),
+    falls back to summing ``live_buffers()`` sizes (works on CPU in
+    current jaxlib), and silently skips devices that answer neither —
+    an empty dict is an honest answer on an uninstrumented backend.
+    """
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return {}
+    out: Dict[str, int] = {}
+    for d in devices:
+        name = f"{d.platform}_{d.id}"
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            out[name] = int(stats["bytes_in_use"])
+            continue
+        try:
+            with warnings.catch_warnings():
+                # jaxlib deprecates per-device live_buffers() but it is
+                # the only per-DEVICE attribution CPU offers today;
+                # don't let every scrape print the notice.
+                warnings.simplefilter("ignore", DeprecationWarning)
+                out[name] = sum(int(b.nbytes) for b in d.live_buffers())
+        except Exception:
+            continue
+    return out
+
+
+def record_device_memory(registry=None) -> Dict[str, int]:
+    """Probe device memory and set ``device_mem_bytes{device=}`` gauges.
+
+    This is the ``extra_fn`` a ``HistorySampler`` runs before each tick,
+    so the watermarks are fresh in the snapshot the tick records. Returns
+    the probe result (handy for the ``/profile`` status body).
+    """
+    if registry is None:
+        from elephas_tpu import obs
+
+        registry = obs.default_registry()
+    snap = device_memory_snapshot()
+    if snap:
+        gauge = registry.gauge(
+            "device_mem_bytes",
+            help="live device buffer bytes, by device",
+            labelnames=("device",))
+        for name, nbytes in snap.items():
+            gauge.labels(device=name).set(nbytes)
+    return snap

@@ -1,11 +1,14 @@
 """opsd: threaded HTTP introspection endpoint for live processes.
 
 Every long-lived process in the system — PS servers, the serving
-``InferenceEngine`` frontend — can mount one of these and answer, while
-under load, the questions that today require attaching a debugger:
+``InferenceEngine`` frontend, trainers — can mount one of these and
+answer, while under load, the questions that today require attaching a
+debugger:
 
 - ``GET /metrics`` — Prometheus text exposition of the process registry
-  (scrapeable by a stock Prometheus server);
+  (scrapeable by a stock Prometheus server), stamped with an
+  ``elephas_process_info{role=,boot=,pid=}`` identity line so merged
+  dumps stay attributable without out-of-band context;
 - ``GET /healthz`` — liveness + an optional health summary (PS servers
   wire their ``MembershipView``/failure-detector state in);
 - ``GET /trace``   — the span ring as Chrome-trace JSON *with the
@@ -17,13 +20,31 @@ under load, the questions that today require attaching a debugger:
 - ``GET /workers`` — the PS's per-worker staleness/contribution ledger
   (``obs.health.StalenessLedger.snapshot``);
 - ``GET /alerts``  — the SLO alert engine's rules, active breaches, and
-  ordered fired history (each scrape runs one evaluation pass).
+  ordered fired history (each scrape runs one evaluation pass);
+- ``GET /meta``    — self-description for fleet federation: role, boot
+  id, worker_id, and the served route list (``obs.fleet`` polls this);
+- ``GET /history?window=N`` — windowed stats from the process's
+  ``HistorySampler`` rings (rates, min/max/last over the trailing N s);
+- ``GET /profile`` — device profiling: bare GET for capture status +
+  per-device memory watermarks, ``?action=start[&dir=]`` /
+  ``?action=stop`` to drive ``jax.profiler`` trace capture remotely;
+- ``GET /fleet``   — the merged fleet view, when this process hosts a
+  ``FleetAggregator`` (usually the one doing the polling).
+
+Routes are registered in an explicit table (``_add_route``), and the
+full vocabulary lives in the module-level ``ROUTES`` constant —
+``scripts/lint_blocking.py`` AST-reads it and rejects unregistered
+route strings at ``add_route`` call sites (``# route-ok`` escapes), so
+the served surface and the documented surface cannot drift. Unknown
+paths answer 404 *with the known-route list in the body*: a scraper
+with a typo learns the fix from the error itself.
 
 Security: opsd binds **loopback by default** (``127.0.0.1``). It serves
 unauthenticated process internals — trace args can contain request ids
-and config values — so exposing it beyond the host is an explicit
-decision: pass ``host=`` or set ``ELEPHAS_OPS_BIND``. This mirrors the
-PS servers' own ``ELEPHAS_PS_BIND`` convention.
+and config values, ``/profile`` can start device captures — so exposing
+it beyond the host is an explicit decision: pass ``host=`` or set
+``ELEPHAS_OPS_BIND``. This mirrors the PS servers' own
+``ELEPHAS_PS_BIND`` convention.
 
 The server is a ``ThreadingHTTPServer`` on a daemon thread: requests
 never touch the training/serving hot paths beyond the GIL, handlers
@@ -37,10 +58,28 @@ import json
 import os
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
-__all__ = ["OpsServer"]
+__all__ = ["OpsServer", "ROUTES"]
+
+#: Registered route vocabulary. Grow this table when adding a route —
+#: ``lint_blocking`` rejects ``add_route`` call sites whose path string
+#: is not listed here, so every served route is documented by construction.
+ROUTES = (
+    "/metrics",
+    "/healthz",
+    "/trace",
+    "/vars",
+    "/flight",
+    "/workers",
+    "/alerts",
+    "/meta",
+    "/history",
+    "/profile",
+    "/fleet",
+)
 
 
 def _default_bind_host() -> str:
@@ -59,6 +98,8 @@ class OpsServer:
     registry / tracer / flight: the surfaces to serve; default to the
         process-global ones resolved lazily at request time (so a
         later ``enable_tracing()`` is picked up without a remount).
+    role / boot / worker_id: process identity for ``/meta`` and the
+        ``elephas_process_info`` stamp on ``/metrics``.
     vars_fn: extra ``/vars`` content, e.g. the PS server's boot id and
         buffer version — called per request so values are live.
     health_fn: extra ``/healthz`` content (membership summary). If it
@@ -69,27 +110,65 @@ class OpsServer:
         probe any process uniformly.
     alerts_fn: the ``/alerts`` payload (an alert-engine scrape); answers
         an empty rule pack when unset.
+    history: a ``HistorySampler`` backing ``/history``; empty shell when
+        unset.
+    profiler: a ``DeviceProfiler`` backing ``/profile``; a default one
+        (jax-backed, tempdir dumps) is created lazily on first use.
+    fleet_fn: the ``/fleet`` payload (a ``FleetAggregator.snapshot``);
+        empty roster when unset.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
                  registry=None, tracer=None, flight=None,
+                 role: str = "proc", boot: Optional[str] = None,
+                 worker_id: Optional[str] = None,
                  vars_fn: Optional[Callable[[], Dict]] = None,
                  health_fn: Optional[Callable[[], Dict]] = None,
                  workers_fn: Optional[Callable[[], Dict]] = None,
-                 alerts_fn: Optional[Callable[[], Dict]] = None):
+                 alerts_fn: Optional[Callable[[], Dict]] = None,
+                 history=None, profiler=None,
+                 fleet_fn: Optional[Callable[[], Dict]] = None):
         self._requested_port = port
         self.host = host if host is not None else _default_bind_host()
         self._registry = registry
         self._tracer = tracer
         self._flight = flight
+        self.role = role
+        self.boot = boot
+        self.worker_id = worker_id
         self._vars_fn = vars_fn
         self._health_fn = health_fn
         self._workers_fn = workers_fn
         self._alerts_fn = alerts_fn
+        self._history = history
+        self._profiler = profiler
+        self._fleet_fn = fleet_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_wall = None
         self.port: Optional[int] = None
+        # Explicit route table: path → handler(query) -> (code, payload[,
+        # content_type]). Every registration is lint-checked against the
+        # module ROUTES vocabulary.
+        self._routes: Dict[str, Callable] = {}
+        self._add_route("/metrics", self._h_metrics)
+        self._add_route("/healthz", self._h_healthz)
+        self._add_route("/trace", self._h_trace)
+        self._add_route("/vars", self._h_vars)
+        self._add_route("/flight", self._h_flight)
+        self._add_route("/workers", self._h_workers)
+        self._add_route("/alerts", self._h_alerts)
+        self._add_route("/meta", self._h_meta)
+        self._add_route("/history", self._h_history)
+        self._add_route("/profile", self._h_profile)
+        self._add_route("/fleet", self._h_fleet)
+
+    def _add_route(self, path: str, handler: Callable) -> None:
+        self._routes[path] = handler
+
+    def routes(self) -> Tuple[str, ...]:
+        """The served route list (sorted) — ``/meta`` and 404 bodies."""
+        return tuple(sorted(self._routes))
 
     # Lazy resolution: a tracer enabled after mount is still served.
     def _get_registry(self):
@@ -109,6 +188,104 @@ class OpsServer:
             return self._flight
         from elephas_tpu import obs
         return obs.default_flight_recorder()
+
+    def _get_profiler(self):
+        if self._profiler is None:
+            from elephas_tpu.obs.devprof import DeviceProfiler
+            self._profiler = DeviceProfiler()
+        return self._profiler
+
+    # -- route handlers: (query) -> (code, payload[, content_type]) ---------
+
+    def _proc_info_line(self) -> str:
+        """The process-identity stamp appended to every ``/metrics``
+        body: merged fleet dumps stay attributable per sample source."""
+        boot = self.boot or ""
+        return (
+            "# TYPE elephas_process_info gauge\n"
+            f'elephas_process_info{{role="{self.role}",boot="{boot}",'
+            f'pid="{os.getpid()}"}} 1\n'
+        )
+
+    def _h_metrics(self, query):
+        text = self._get_registry().expose_text() + self._proc_info_line()
+        return 200, text.encode(), "text/plain; version=0.0.4"
+
+    def _h_healthz(self, query):
+        doc = {"status": "ok",
+               "uptime_s": time.time() - self._started_wall}
+        if self._health_fn is not None:
+            doc.update(self._health_fn())
+        return 200, doc
+
+    def _h_trace(self, query):
+        return 200, self._get_tracer().export_chrome()
+
+    def _h_vars(self, query):
+        doc = {"pid": os.getpid(),
+               "ops_host": self.host,
+               "ops_port": self.port}
+        if self._vars_fn is not None:
+            doc.update(self._vars_fn())
+        return 200, doc
+
+    def _h_flight(self, query):
+        return 200, self._get_flight().snapshot()
+
+    def _h_workers(self, query):
+        if self._workers_fn is not None:
+            return 200, self._workers_fn()
+        return 200, {"workers": {}, "total_updates": 0,
+                     "unstamped_updates": 0}
+
+    def _h_alerts(self, query):
+        if self._alerts_fn is not None:
+            return 200, self._alerts_fn()
+        return 200, {"rules": [], "active": [], "fired": [],
+                     "fired_kinds": []}
+
+    def _h_meta(self, query):
+        return 200, {
+            "role": self.role,
+            "boot": self.boot,
+            "worker_id": self.worker_id,
+            "pid": os.getpid(),
+            "ops_host": self.host,
+            "ops_port": self.port,
+            "routes": list(self.routes()),
+        }
+
+    def _h_history(self, query):
+        window = query.get("window")
+        window_s = float(window) if window else None
+        if self._history is None:
+            return 200, {"period_s": None, "capacity": 0,
+                         "window_s": window_s, "ticks": 0, "series": {}}
+        return 200, self._history.snapshot(window_s=window_s)
+
+    def _h_profile(self, query):
+        from elephas_tpu.obs import devprof
+
+        action = query.get("action")
+        prof = self._get_profiler()
+        if action is None:
+            return 200, {"profiler": prof.status(),
+                         "device_memory": devprof.device_memory_snapshot()}
+        if action == "start":
+            doc = prof.start(out_dir=query.get("dir"))
+            code = {"started": 200, "busy": 409}.get(doc["status"], 500)
+            return code, doc
+        if action == "stop":
+            doc = prof.stop()
+            return (200 if doc["status"] in ("stopped", "idle")
+                    else 500), doc
+        return 400, {"error": f"unknown action {action!r}",
+                     "actions": ["start", "stop"]}
+
+    def _h_fleet(self, query):
+        if self._fleet_fn is not None:
+            return 200, self._fleet_fn()
+        return 200, {"polls": 0, "status_counts": {}, "processes": {}}
 
     def start(self) -> "OpsServer":
         if self._httpd is not None:
@@ -134,43 +311,24 @@ class OpsServer:
 
             def do_GET(self):  # noqa: N802
                 try:
-                    if self.path == "/metrics":
-                        text = ops._get_registry().expose_text()
-                        self._send(200, text.encode(),
-                                   "text/plain; version=0.0.4")
-                    elif self.path == "/healthz":
-                        doc = {"status": "ok",
-                               "uptime_s": time.time() - ops._started_wall}
-                        if ops._health_fn is not None:
-                            doc.update(ops._health_fn())
-                        self._send_json(200, doc)
-                    elif self.path == "/trace":
-                        self._send_json(200,
-                                        ops._get_tracer().export_chrome())
-                    elif self.path == "/vars":
-                        doc = {"pid": os.getpid(),
-                               "ops_host": ops.host,
-                               "ops_port": ops.port}
-                        if ops._vars_fn is not None:
-                            doc.update(ops._vars_fn())
-                        self._send_json(200, doc)
-                    elif self.path == "/flight":
-                        self._send_json(200, ops._get_flight().snapshot())
-                    elif self.path == "/workers":
-                        doc = (ops._workers_fn() if ops._workers_fn
-                               is not None else
-                               {"workers": {}, "total_updates": 0,
-                                "unstamped_updates": 0})
-                        self._send_json(200, doc)
-                    elif self.path == "/alerts":
-                        doc = (ops._alerts_fn() if ops._alerts_fn
-                               is not None else
-                               {"rules": [], "active": [], "fired": [],
-                                "fired_kinds": []})
-                        self._send_json(200, doc)
+                    split = urllib.parse.urlsplit(self.path)
+                    handler = ops._routes.get(split.path)
+                    if handler is None:
+                        self._send_json(404, {
+                            "error": "not found",
+                            "path": split.path,
+                            "routes": list(ops.routes()),
+                        })
+                        return
+                    query = {k: v[-1] for k, v in
+                             urllib.parse.parse_qs(split.query).items()}
+                    result = handler(query)
+                    if len(result) == 3:
+                        code, payload, ctype = result
+                        self._send(code, payload, ctype)
                     else:
-                        self._send_json(404, {"error": "not found",
-                                              "path": self.path})
+                        code, payload = result
+                        self._send_json(code, payload)
                 except Exception as exc:  # surface, don't hang the scrape
                     try:
                         self._send_json(500, {"error": repr(exc)})

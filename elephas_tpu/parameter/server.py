@@ -324,25 +324,42 @@ class _ObservableServerMixin:
     def _mount_ops(self, transport: str) -> None:
         if self.ops_port is None:
             return
+        from elephas_tpu.obs.devprof import (DeviceProfiler,
+                                             record_device_memory)
+        from elephas_tpu.obs.history import HistorySampler
         from elephas_tpu.obs.opsd import OpsServer
 
         buffer, detector, boot = self.buffer, self.detector, self.boot
         ledger, alerts = self.ledger, self.alerts
+        # History rings + device profiler ride on the mount: sampling
+        # runs on a daemon thread (scrape-independent), profiler dumps
+        # land next to the WAL — one directory per incarnation holds
+        # the flight dump, the WAL, and any device captures.
+        self._ops_history = HistorySampler(
+            extra_fn=record_device_memory).start()
+        self._ops_profiler = DeviceProfiler(out_dir=self._wal_dir)
         self.ops = OpsServer(
             port=self.ops_port,
             tracer=self.tracer,  # None → live process default
+            role="ps", boot=boot,
             vars_fn=lambda: {"boot": boot, "version": buffer.version,
                              "transport": transport,
                              "ps_host": self.host, "ps_port": self.port},
             health_fn=lambda: {"membership": detector.membership()},
             workers_fn=ledger.snapshot,
             alerts_fn=alerts.scrape,
+            history=self._ops_history,
+            profiler=self._ops_profiler,
         ).start()
 
     def _unmount_ops(self) -> None:
         if self.ops is not None:
             self.ops.stop()
             self.ops = None
+        sampler = getattr(self, "_ops_history", None)
+        if sampler is not None:
+            sampler.stop()
+            self._ops_history = None
 
     def _record_kill(self) -> None:
         """Flight-record the crash and dump the ring to disk — BEFORE

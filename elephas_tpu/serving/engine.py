@@ -492,13 +492,18 @@ class InferenceEngine:
         if self.ops is not None:
             return self.ops
         from elephas_tpu import obs
+        from elephas_tpu.obs.devprof import record_device_memory
         from elephas_tpu.obs.opsd import OpsServer
 
         if getattr(self, "_alert_engine", None) is None:
             self._alert_engine = obs.AlertEngine()
+        self._ops_history = obs.HistorySampler(
+            extra_fn=record_device_memory).start()
         self.ops = OpsServer(
             port=port, host=host, tracer=self.tracer,
+            role="serving",
             alerts_fn=self._alert_engine.scrape,
+            history=self._ops_history,
             vars_fn=lambda: {
                 "role": "serving",
                 "max_slots": self.pool.max_slots,
@@ -516,6 +521,10 @@ class InferenceEngine:
         if self.ops is not None:
             self.ops.stop()
             self.ops = None
+        sampler = getattr(self, "_ops_history", None)
+        if sampler is not None:
+            sampler.stop()
+            self._ops_history = None
 
 
 def shard_serving(engine: InferenceEngine, mesh, rules=None) -> InferenceEngine:

@@ -85,6 +85,16 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             # loosest in the table — it catches order-of-magnitude
             # staleness blowups, not ±1 version jitter.
             ("staleness_p95", "lower", 2.00),
+            # Fleet row (--fleet): polling N live ops endpoints + the
+            # bucket-wise merge must stay cheap enough to run at a 1 s
+            # cadence. Absolute ceilings, same style as the serving
+            # trace guardrail — the scrape cost budget doesn't move
+            # with whatever a loaded CI machine measured last time.
+            ("fleet_scrape_ms_mean", "limit", 150.0),
+            ("fleet_merge_ms_mean", "limit", 50.0),
+            # Replay-stable outage visibility: the kill_ps fleet row
+            # must show the full alive→stale→dead→alive arc.
+            ("fleet_saw_outage", "equal", 0.0),
         ],
     ),
 }

@@ -48,11 +48,21 @@ histogram) plus the deterministic fake-clock ``alert_ladder`` sequence
 ``test_chaos.py`` and gated by ``bench_gate.py``'s ``staleness_p95``
 rule.
 
+``--fleet`` appends a ``{"scenario": "fleet"}`` row: the kill_ps chaos
+arm re-run with ops endpoints mounted on BOTH sides (the elastic PS via
+``ps_ops_port``, the trainer process via ``mount_ops``) and a
+``FleetAggregator`` polling them through the outage. The row commits
+the PS roster entry's observed transition sequence — a warm-restarted
+PS must read ``alive → stale → dead → alive`` in the fleet view, never
+vanish — plus the measured per-poll scrape cost and merge cost that
+``bench_gate.py``'s absolute ``fleet_scrape_ms_mean`` /
+``fleet_merge_ms_mean`` ceilings gate.
+
 Importable without a TPU; tier-1-sized defaults finish in ~1 min on
 CPU. Usage:
     python scripts/chaos_bench.py [--epochs 4] [--outage 4.0]
         [--n 256] [--out BENCH_CHAOS.json] [--health] [--seed 11]
-        [--trace] [--trace-dir D]
+        [--trace] [--trace-dir D] [--fleet]
 """
 
 from __future__ import annotations
@@ -93,7 +103,8 @@ def _build_net():
     )
 
 
-def _build_trainer(fault_plan=None, wal_dir=None, grace: float = 30.0):
+def _build_trainer(fault_plan=None, wal_dir=None, grace: float = 30.0,
+                   ps_ops_port=None):
     from elephas_tpu.engine.async_engine import AsyncTrainer
     from elephas_tpu.parallel.mesh import build_mesh
 
@@ -102,6 +113,7 @@ def _build_trainer(fault_plan=None, wal_dir=None, grace: float = 30.0):
         net, build_mesh(num_data=2), frequency="epoch",
         parameter_server_mode="socket", port=0, elastic=True,
         fault_plan=fault_plan, ps_wal_dir=wal_dir, ps_recovery_grace=grace,
+        ps_ops_port=ps_ops_port,
     )
 
 
@@ -203,6 +215,110 @@ def scenario_partition(x, y, epochs):
     history, stats, wall, _ = _run_fit(trainer, x, y, epochs)
     return _stats_row("partition", history, stats, wall,
                       trace_digest=hex(plan.trace_digest()))
+
+
+def scenario_fleet(x, y, epochs, outage: float):
+    """``--fleet``: the kill_ps arm observed through the federation
+    plane. The elastic PS mounts an ops endpoint (``ps_ops_port=0``),
+    the trainer process mounts its own (role ``worker``), and a
+    ``FleetAggregator`` polls both at a 0.25 s cadence through kill →
+    outage → warm restart. The PS roster entry must walk
+    alive → stale → dead → alive — dead, not gone, is the contract.
+    Per-poll scrape and merge costs are measured for the gate."""
+    from elephas_tpu.obs.fleet import FleetAggregator
+    from elephas_tpu.parameter.server import make_server
+
+    dead_after = max(0.75, min(2.0, outage / 2.0))
+    agg = FleetAggregator(dead_after=dead_after, timeout=1.0)
+    scrape_ms, merge_ms = [], []
+    stop = threading.Event()
+
+    def poller():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            agg.poll()
+            scrape_ms.append((time.perf_counter() - t0) * 1000.0)
+            t0 = time.perf_counter()
+            agg.snapshot()
+            merge_ms.append((time.perf_counter() - t0) * 1000.0)
+            stop.wait(0.25)
+
+    poll_thread = threading.Thread(target=poller, daemon=True)
+
+    def chaos(trainer):
+        while (trainer._elastic_server is None
+               or trainer._elastic_server.ops is None):
+            time.sleep(0.005)
+        server = trainer._elastic_server
+        port, wal_dir = server.port, trainer.ps_wal_dir
+        ops_port = server.ops.port  # warm restart re-mounts HERE, so
+        agg.add(server.ops.url, name="ps")  # the roster URL stays valid
+        agg.add(trainer.mount_ops().url, name="worker")
+        poll_thread.start()
+        while server.buffer.version < 3:
+            time.sleep(0.005)
+        server.kill()  # also unmounts ops: the fleet MUST see it go dark
+        killed_at = server.buffer.version
+        time.sleep(outage)
+        cold = _build_net()
+        fresh = make_server(
+            "socket",
+            {"params": cold.params, "batch_stats": cold.batch_stats},
+            port=port, wal_dir=wal_dir, ops_port=ops_port,
+        )
+        fresh.start()
+        trainer._elastic_server = fresh
+        # Hold until the poller has seen the restarted PS: the
+        # alive-after-outage transition must be recorded while the
+        # server is still up (the fit teardown stops it at the end).
+        deadline = time.perf_counter() + 15.0
+        while (agg.registry.get("ps").status != "alive"
+               and time.perf_counter() < deadline):
+            time.sleep(0.05)
+        # Polling ends HERE, not in the finally: once the restart has
+        # been observed the transition record is complete, and letting
+        # the poller race the fit teardown would append a spurious
+        # trailing "stale" when it catches the final server stop.
+        stop.set()
+        return {"durable_version_at_kill": killed_at,
+                "resumed_version": fresh.buffer.version,
+                "outage_hold_s": outage}
+
+    with tempfile.TemporaryDirectory() as wal_dir:
+        trainer = _build_trainer(wal_dir=wal_dir, grace=max(30.0, 4 * outage),
+                                 ps_ops_port=0)
+        try:
+            history, stats, wall, detail = _run_fit(trainer, x, y, epochs,
+                                                    chaos=chaos)
+        finally:
+            stop.set()
+            if poll_thread.is_alive():
+                poll_thread.join(timeout=5)
+            trainer.unmount_ops()
+
+    ps_entry = agg.registry.get("ps")
+    seq = [s for _, s in ps_entry.transitions]
+    saw_outage = ("alive" in seq and "dead" in seq
+                  and seq.index("alive") < seq.index("dead")
+                  and seq[-1] == "alive")
+    worker_seq = [s for _, s in agg.registry.get("worker").transitions]
+    row = _stats_row(
+        "fleet", history, stats, wall, **detail,
+        fleet_transitions=seq,
+        fleet_saw_outage=saw_outage,
+        worker_transitions=worker_seq,
+        dead_after_s=dead_after,
+        fleet_polls=agg.polls,
+        fleet_scrape_ms_mean=round(sum(scrape_ms) / len(scrape_ms), 2),
+        fleet_scrape_ms_max=round(max(scrape_ms), 2),
+        fleet_merge_ms_mean=round(sum(merge_ms) / len(merge_ms), 2),
+    )
+    # The fleet arm races the (fast) fit against the kill, so the final
+    # evaluation may run against the cold-restarted store — its loss is
+    # timing noise, not a gated signal. Dropping it keeps the committed
+    # baseline row from teaching bench_gate a nondeterministic rule.
+    del row["final_loss"]
+    return row
 
 
 def alert_ladder(seed: int):
@@ -392,6 +508,11 @@ def main(argv=None):
                          "per-unit critical-path table")
     ap.add_argument("--trace-dir", default=".",
                     help="where --trace writes its three JSON artifacts")
+    ap.add_argument("--fleet", action="store_true",
+                    help="append the federation row: kill_ps observed "
+                         "through a FleetAggregator polling the PS and "
+                         "trainer ops endpoints (stale→dead→alive "
+                         "transitions + measured scrape/merge cost)")
     args = ap.parse_args(argv)
 
     tracer = None
@@ -410,10 +531,13 @@ def main(argv=None):
     rows.append(scenario_partition(x, y, args.epochs))
     if args.health:
         rows.append(scenario_health(x, y, args.epochs, seed=args.seed))
+    if args.fleet:
+        rows.append(scenario_fleet(x, y, args.epochs, args.outage))
 
     anchor = rows[1]["final_loss"]
     for row in rows[2:]:
-        row["loss_vs_baseline"] = round(row["final_loss"] - anchor, 5)
+        if "final_loss" in row:
+            row["loss_vs_baseline"] = round(row["final_loss"] - anchor, 5)
 
     for row in rows:
         print(json.dumps(row))

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""fleet_top: one-shot (or interval) text view over N ops endpoints.
+
+The terminal counterpart of the ``/fleet`` route: point it at every
+process's opsd URL and get the merged picture — who is alive/stale/dead
+(with boot ids, so a warm restart is visible as the same slot coming
+back different), the fleet-summed counters, pooled histogram
+percentiles, cluster worker ledger, and active alerts.
+
+Usage:
+    python scripts/fleet_top.py http://127.0.0.1:8801 http://127.0.0.1:8802
+    python scripts/fleet_top.py --interval 2 ps=http://127.0.0.1:8801 \
+        w0=http://127.0.0.1:8802
+    python scripts/fleet_top.py --json http://127.0.0.1:8801
+
+Endpoints may be bare URLs (auto-named ``proc0``, ``proc1``, …) or
+``name=url`` pairs. ``--interval`` repolls forever (Ctrl-C to stop);
+``--json`` dumps the raw merged snapshot instead of the table. The
+aggregator never drops an unreachable process — it goes stale, then
+dead after ``--dead-after`` seconds, and stays on the board.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elephas_tpu.obs.fleet import FleetAggregator  # noqa: E402
+
+
+def render(snap: dict) -> str:
+    """The merged fleet snapshot as a fixed-width text board."""
+    lines: List[str] = []
+    counts = snap["status_counts"]
+    summary = "  ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+    lines.append(f"fleet: {len(snap['processes'])} processes  {summary}"
+                 f"  polls={snap['polls']}")
+    lines.append("")
+    lines.append(f"{'NAME':<10} {'ROLE':<8} {'STATUS':<7} {'BOOT':<14} "
+                 f"{'WORKER':<8} {'LAST OK':>8}  URL")
+    for name, p in sorted(snap["processes"].items()):
+        meta = p.get("meta") or {}
+        ago = p.get("last_ok_s_ago")
+        lines.append(
+            f"{name:<10} {str(meta.get('role', '?')):<8} "
+            f"{p['status']:<7} {str(meta.get('boot', ''))[:14]:<14} "
+            f"{str(meta.get('worker_id') or '-'):<8} "
+            f"{('%.1fs' % ago) if ago is not None else '-':>8}  {p['url']}"
+        )
+    metrics = snap["metrics"]
+    if metrics["counters"]:
+        lines.append("")
+        lines.append("counters (fleet sum):")
+        for key, v in sorted(metrics["counters"].items()):
+            lines.append(f"  {key:<56} {v:g}")
+    if metrics["histograms"]:
+        lines.append("")
+        lines.append(f"{'histogram (pooled)':<44} {'count':>8} "
+                     f"{'p50':>10} {'p95':>10} {'p99':>10}")
+        for key, h in sorted(metrics["histograms"].items()):
+            def fmt(x):
+                return f"{x:.4g}" if x is not None else "-"
+            lines.append(f"  {key:<42} {h['count']:>8} "
+                         f"{fmt(h['p50']):>10} {fmt(h['p95']):>10} "
+                         f"{fmt(h['p99']):>10}")
+    workers = snap["workers"]
+    if workers["workers"]:
+        lines.append("")
+        lines.append(f"workers (cluster ledger): "
+                     f"total_updates={workers['total_updates']}")
+        for wid, row in sorted(workers["workers"].items()):
+            lines.append(f"  {wid:<12} updates={row.get('updates', '?')} "
+                         f"lag_max={row.get('lag_max', '?')}")
+    alerts = snap["alerts"]
+    if alerts["active"] or alerts["fired_total"]:
+        lines.append("")
+        lines.append(f"alerts: active={len(alerts['active'])} "
+                     f"fired={alerts['fired_total']} "
+                     f"kinds={','.join(alerts['fired_kinds']) or '-'}")
+        for a in alerts["active"]:
+            lines.append(f"  [{a['proc']}] {a['rule']} on {a['metric']}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> dict:
+    ap = argparse.ArgumentParser(
+        description="Merged text view over N opsd endpoints")
+    ap.add_argument("endpoints", nargs="+",
+                    help="ops URLs, bare or name=url")
+    ap.add_argument("--interval", type=float, default=None,
+                    help="repoll every N seconds (default: one shot)")
+    ap.add_argument("--dead-after", type=float, default=10.0,
+                    help="seconds without a successful poll before an "
+                         "unreachable process reads dead (default 10)")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-request scrape timeout (default 2)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw merged snapshot instead of a table")
+    args = ap.parse_args(argv)
+
+    agg = FleetAggregator(dead_after=args.dead_after, timeout=args.timeout)
+    for spec in args.endpoints:
+        if "=" in spec and not spec.startswith("http"):
+            name, url = spec.split("=", 1)
+            agg.add(url, name=name)
+        else:
+            agg.add(spec)
+
+    snap = {}
+    while True:
+        agg.poll()
+        snap = agg.snapshot()
+        if args.json:
+            print(json.dumps(snap, indent=1))
+        else:
+            print(render(snap))
+        if args.interval is None:
+            break
+        try:
+            time.sleep(args.interval)
+            print()
+        except KeyboardInterrupt:
+            break
+    return snap
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

@@ -81,6 +81,17 @@ defining modules' ASTs — the lint never imports the package. Grow the
 table to add a kind; ``# kind-ok`` escapes deliberate test-local vocab.
 This rule also scans ``scripts/``.
 
+A seventh rule closes the OPS ROUTE VOCABULARY: every path the
+``OpsServer`` serves is registered through ``add_route("/…")`` against
+the ``obs.opsd.ROUTES`` constant — the table ``/meta`` advertises, 404
+bodies list, and the fleet aggregator polls. A route string at an
+``add_route``/``_add_route`` call site that isn't in ``ROUTES`` (or any
+f-string path) means the served surface and the documented surface have
+drifted, so it's flagged; grow ``ROUTES`` to add a route. The
+vocabulary is AST-read from ``opsd.py`` like the kind tables. Escape
+pragma: ``# route-ok``, for test-local throwaway routes. This rule also
+scans ``scripts/``.
+
 Wired into tier-1 via ``tests/test_lint_blocking.py``; also runnable
 standalone: ``python scripts/lint_blocking.py`` (exit 1 on violations).
 """
@@ -99,6 +110,7 @@ PICKLE_SANCTIONED = "wire.py"
 CLOCK_PRAGMA = "clock-ok"
 METRIC_PRAGMA = "metric-ok"
 KIND_PRAGMA = "kind-ok"
+ROUTE_PRAGMA = "route-ok"
 _NUMPY_NAMES = ("np", "numpy")
 _CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
 _PICKLE_ATTRS = ("dumps", "loads", "dump", "load")
@@ -113,6 +125,14 @@ class Violation(NamedTuple):
     domain: str = "serving"
 
     def __str__(self):
+        if self.domain == "route":
+            return (
+                f"{self.path}:{self.lineno}: unregistered route "
+                f"{self.call} — opsd routes come from obs.opsd.ROUTES "
+                f"(grow the table so /meta, 404 bodies, and the fleet "
+                f"poller stay in sync; `# {ROUTE_PRAGMA}` for test-local "
+                f"throwaway routes)\n    {self.line.strip()}"
+            )
         if self.domain == "kind":
             return (
                 f"{self.path}:{self.lineno}: unregistered {self.call} — "
@@ -420,6 +440,72 @@ def lint_kind_package(pkg_root: Path,
     return out
 
 
+def load_route_vocab(pkg_root: Path) -> Tuple[str, ...]:
+    """``ROUTES`` read straight from ``obs/opsd.py``'s AST — a
+    pure-literal tuple by construction, so ``literal_eval`` suffices and
+    the lint never imports the package."""
+    tree = ast.parse((pkg_root / "obs" / "opsd.py").read_text())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "ROUTES"
+                for t in node.targets):
+            return tuple(ast.literal_eval(node.value))
+    raise RuntimeError("obs/opsd.py has no literal ROUTES table")
+
+
+def _route_call_names(node: ast.Call, routes) -> List[str]:
+    """Unregistered-route findings for one call: a string literal (or
+    f-string) as the first argument of ``add_route``/``_add_route``.
+    Paths through variables pass — linted at the literal's definition."""
+    fn = node.func
+    callee = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    if callee not in ("add_route", "_add_route") or not node.args:
+        return []
+    arg = node.args[0]
+    if isinstance(arg, ast.JoinedStr):
+        return [f"<f-string> in {callee}()"]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+            and arg.value not in routes:
+        return [f"`{arg.value}` in {callee}()"]
+    return []
+
+
+def lint_route_file(path: Path, routes) -> List[Violation]:
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        names = _route_call_names(node, routes)
+        if not names:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if ROUTE_PRAGMA in line:
+            continue
+        for name in names:
+            out.append(Violation(str(path), node.lineno, name, line,
+                                 domain="route"))
+    return out
+
+
+def lint_route_package(pkg_root: Path,
+                       extra_roots: Tuple[Path, ...] = ()) -> List[Violation]:
+    """Lint the whole package tree plus any extra roots (``scripts/``) —
+    the route table is what every fleet poller keys on, so no file is
+    exempt."""
+    routes = load_route_vocab(pkg_root)
+    out = []
+    paths = sorted(pkg_root.rglob("*.py"))
+    for root in extra_roots:
+        paths.extend(sorted(root.glob("*.py")))
+    for path in paths:
+        out.extend(lint_route_file(path, routes))
+    return out
+
+
 def main(argv: List[str] | None = None) -> List[Violation]:
     args = list(sys.argv[1:] if argv is None else argv)
     pkg_root = Path(__file__).resolve().parent.parent / "elephas_tpu"
@@ -430,6 +516,8 @@ def main(argv: List[str] | None = None) -> List[Violation]:
         violations.extend(lint_resilience_package(pkg_root / "resilience"))
         violations.extend(lint_metric_package(pkg_root))
         violations.extend(lint_kind_package(
+            pkg_root, extra_roots=(Path(__file__).resolve().parent,)))
+        violations.extend(lint_route_package(
             pkg_root, extra_roots=(Path(__file__).resolve().parent,)))
     for v in violations:
         print(v)

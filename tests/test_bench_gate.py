@@ -180,3 +180,34 @@ def test_staleness_rule_gates_health_row():
         ("health", "staleness_p95")]
     by = _checks_by_metric(bg.compare(base, base, "chaos"))
     assert ("kill_worker", "staleness_p95") not in by  # absent → not gated
+
+
+def test_fleet_rules_gate_scrape_cost_and_outage_visibility():
+    """The --fleet chaos row: scrape/merge costs are ABSOLUTE ceilings
+    (the budget doesn't move with a loaded baseline machine), and
+    fleet_saw_outage is exact — a run where the PS kill never became
+    visible as dead-then-alive in the fleet view fails the gate."""
+    base = [{"scenario": "fleet", "completed_units": 8,
+             "fleet_scrape_ms_mean": 7.0, "fleet_merge_ms_mean": 0.2,
+             "fleet_saw_outage": True}]
+    good = bg.compare(base, [
+        {"scenario": "fleet", "completed_units": 8,
+         "fleet_scrape_ms_mean": 120.0,  # slower than base, under ceiling
+         "fleet_merge_ms_mean": 40.0, "fleet_saw_outage": True}], "chaos")
+    assert all(c["ok"] for c in good)
+
+    bad = bg.compare(base, [
+        {"scenario": "fleet", "completed_units": 8,
+         "fleet_scrape_ms_mean": 200.0, "fleet_merge_ms_mean": 60.0,
+         "fleet_saw_outage": False}], "chaos")
+    failed = sorted((c["key"], c["metric"]) for c in bad if not c["ok"])
+    assert failed == [("fleet", "fleet_merge_ms_mean"),
+                      ("fleet", "fleet_saw_outage"),
+                      ("fleet", "fleet_scrape_ms_mean")]
+    # The ceilings are baseline-independent: the threshold text carries
+    # the absolute limit, not a multiple of the committed number.
+    by = _checks_by_metric(bad)
+    assert by[("fleet", "fleet_scrape_ms_mean")]["threshold"] == \
+        "must be <= 150.0"
+    assert by[("fleet", "fleet_merge_ms_mean")]["threshold"] == \
+        "must be <= 50.0"

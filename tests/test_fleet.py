@@ -1,0 +1,481 @@
+"""Fleet federation (``elephas_tpu.obs.fleet``): exposition parsing,
+merge semantics, and the roster lifecycle.
+
+The contracts pinned here are the ISSUE's acceptance criteria:
+
+- counters **sum** across processes, gauges stay per-process (tagged
+  ``proc=``), fixed-bucket histograms merge **bucket-wise** so fleet
+  percentiles are computed on the pooled distribution — within one
+  bucket width of the exact pooled quantile, pinned against live
+  scrapes of three real OpsServers;
+- an unreachable process is *marked* stale, then dead after
+  ``dead_after`` — never dropped — and its last-known counters keep
+  contributing to the merge through the outage;
+- concurrent scrapes against live servers under a mutating writer
+  never produce torn bodies (the ``test_opsd`` hammer, one level up).
+"""
+
+import json
+import threading
+
+import pytest
+
+from elephas_tpu.obs import FlightRecorder, MetricsRegistry, Tracer
+from elephas_tpu.obs.fleet import (
+    FleetAggregator,
+    ProcessRegistry,
+    bucket_percentile,
+    canonical_label_key,
+    merge_metrics,
+    parse_prometheus_text,
+)
+from elephas_tpu.obs.opsd import OpsServer
+
+import scripts.trace_report as trace_report
+
+
+# --------------------------------------------------------------------------
+# Exposition parsing
+# --------------------------------------------------------------------------
+
+
+def test_parse_round_trips_registry_exposition():
+    """The parser reads exactly what ``expose_text`` writes — one wire
+    format across the federation, no private RPC."""
+    reg = MetricsRegistry()
+    reg.counter("ps_push_total", help="pushes",
+                labelnames=("worker",)).labels(worker="w1").inc(3)
+    reg.gauge("ps_queue_depth", help="depth").set(7)
+    fams = parse_prometheus_text(reg.expose_text())
+    assert fams["ps_push_total"]["kind"] == "counter"
+    assert fams["ps_push_total"]["samples"] == [({"worker": "w1"}, 3.0)]
+    assert fams["ps_queue_depth"]["kind"] == "gauge"
+    assert fams["ps_queue_depth"]["samples"] == [({}, 7.0)]
+
+
+def test_parse_decumulates_histogram_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("ps_apply_seconds", buckets=[0.1, 1.0])
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    fams = parse_prometheus_text(reg.expose_text())
+    hist = fams["ps_apply_seconds"]["histograms"][""]
+    assert hist["bounds"] == (0.1, 1.0)
+    # Per-bucket (de-cumulated) counts with the trailing +inf bucket.
+    assert hist["counts"] == [1, 2, 1]
+    assert hist["count"] == 4
+    assert hist["sum"] == pytest.approx(6.05)
+
+
+def test_parse_honors_label_escapes():
+    text = ('# TYPE weird_total counter\n'
+            'weird_total{msg="a\\"b\\\\c\\nd",x="y"} 2\n')
+    fams = parse_prometheus_text(text)
+    (labels, value), = fams["weird_total"]["samples"]
+    assert labels == {"msg": 'a"b\\c\nd', "x": "y"}
+    assert value == 2.0
+
+
+def test_canonical_label_key_is_order_insensitive():
+    assert canonical_label_key({"b": "2", "a": "1"}) == '{a="1",b="2"}'
+    assert canonical_label_key({}) == ""
+
+
+# --------------------------------------------------------------------------
+# Pooled-bucket percentiles
+# --------------------------------------------------------------------------
+
+
+def test_bucket_percentile_interpolates_and_bounds():
+    # 10 in (0, 1], 10 in (1, 2]: the median sits at the 1.0 edge.
+    assert bucket_percentile((1.0, 2.0), [10, 10, 0], 0.50) == \
+        pytest.approx(1.0)
+    assert bucket_percentile((1.0, 2.0), [10, 10, 0], 0.25) == \
+        pytest.approx(0.5)
+    assert bucket_percentile((1.0, 2.0), [0, 0, 0], 0.5) is None  # empty
+    # Everything in the +inf bucket: the last finite bound is the best
+    # honest answer available over the wire.
+    assert bucket_percentile((1.0, 2.0), [0, 0, 5], 0.99) == 2.0
+    with pytest.raises(ValueError):
+        bucket_percentile((1.0,), [1, 0], 1.5)
+
+
+# --------------------------------------------------------------------------
+# Merge semantics (pure, on parsed expositions)
+# --------------------------------------------------------------------------
+
+
+def _exposition(counter_value, gauge_value, hist_vals, buckets=(0.1, 1.0),
+                hist_name="ps_apply_seconds"):
+    reg = MetricsRegistry()
+    reg.counter("ps_push_total", help="pushes",
+                labelnames=("worker",)).labels(worker="w1").inc(counter_value)
+    reg.gauge("ps_queue_depth", help="depth").set(gauge_value)
+    h = reg.histogram(hist_name, buckets=list(buckets))
+    for v in hist_vals:
+        h.observe(v)
+    return parse_prometheus_text(reg.expose_text())
+
+
+def test_merge_sums_counters_and_tags_gauges_per_proc():
+    merged = merge_metrics({
+        "ps": _exposition(3, 7, []),
+        "w1": _exposition(5, 2, []),
+    })
+    # Counters: one fleet total per (name, labels).
+    assert merged["counters"] == {'ps_push_total{worker="w1"}': 8.0}
+    # Gauges: summing queue depths across processes is a lie — one
+    # child per process, tagged with its roster name.
+    assert merged["gauges"] == {
+        'ps_queue_depth{proc="ps"}': 7.0,
+        'ps_queue_depth{proc="w1"}': 2.0,
+    }
+
+
+def test_merge_histograms_bucketwise_when_bounds_agree():
+    merged = merge_metrics({
+        "ps": _exposition(1, 0, [0.05, 0.5]),
+        "w1": _exposition(1, 0, [0.5, 5.0]),
+    })
+    h = merged["histograms"]["ps_apply_seconds"]
+    assert h["count"] == 4
+    assert h["sum"] == pytest.approx(6.05)
+    assert h["procs"] == ["ps", "w1"]
+    assert merged["unmerged_histograms"] == []
+    # The pooled percentile is recomputed from summed buckets — the
+    # same answer bucket_percentile gives on hand-pooled counts.
+    assert h["p50"] == pytest.approx(
+        bucket_percentile((0.1, 1.0), [1, 2, 1], 0.50))
+
+
+def test_merge_keeps_mismatched_bucket_ladders_apart():
+    """Bucket-wise merging across different ladders would corrupt the
+    percentiles — mismatches stay per-proc and are listed visibly."""
+    merged = merge_metrics({
+        "ps": _exposition(1, 0, [0.05], buckets=(0.1, 1.0)),
+        "w1": _exposition(1, 0, [0.05], buckets=(0.2, 2.0)),
+    })
+    keys = set(merged["histograms"])
+    assert "ps_apply_seconds" in keys  # first ladder keeps the key
+    assert "ps_apply_seconds[proc=w1]" in keys
+    assert merged["unmerged_histograms"] == ["ps_apply_seconds[proc=w1]"]
+
+
+def test_merge_rolls_up_workers_and_alerts():
+    agg = FleetAggregator(clock=lambda: 0.0, fetch=_fake_fetch_factory({
+        "http://a": _fake_bodies(
+            workers={"workers": {"w1": {"updates": 3, "lag_max": 1}},
+                     "total_updates": 3, "unstamped_updates": 0},
+            alerts={"rules": [], "active": [{"rule": "r", "metric": "m"}],
+                    "fired": [{"kind": "slo_breach"}], "fired_kinds": []}),
+        "http://b": _fake_bodies(
+            workers={"workers": {"w1": {"updates": 5, "lag_max": 2}},
+                     "total_updates": 5, "unstamped_updates": 1},
+            alerts={"rules": [], "active": [], "fired": [], "fired_kinds": []}),
+    }))
+    agg.add("http://a", name="a")
+    agg.add("http://b", name="b")
+    agg.poll(now=0.0)
+    snap = agg.snapshot(now=0.0)
+    # Same worker id reported by two processes: both survive, keyed by
+    # owner, and the totals still sum.
+    assert set(snap["workers"]["workers"]) == {"a/w1", "b/w1"}
+    assert snap["workers"]["total_updates"] == 8
+    assert snap["workers"]["unstamped_updates"] == 1
+    assert snap["alerts"]["active"] == [
+        {"rule": "r", "metric": "m", "proc": "a"}]
+    assert snap["alerts"]["fired_total"] == 1
+    assert snap["alerts"]["fired_kinds"] == ["slo_breach"]
+
+
+# --------------------------------------------------------------------------
+# Roster + lifecycle (injected clock and fetch — no sockets)
+# --------------------------------------------------------------------------
+
+
+def _fake_bodies(metrics_text="", workers=None, alerts=None, meta=None):
+    return {
+        "/meta": json.dumps(meta or {"role": "proc", "boot": "b0"}).encode(),
+        "/metrics": metrics_text.encode(),
+        "/workers": json.dumps(workers or {"workers": {},
+                                           "total_updates": 0,
+                                           "unstamped_updates": 0}).encode(),
+        "/alerts": json.dumps(alerts or {"rules": [], "active": [],
+                                         "fired": [],
+                                         "fired_kinds": []}).encode(),
+    }
+
+
+def _fake_fetch_factory(bodies_by_url):
+    """fetch(url, timeout) over a dict; a missing base url raises like
+    a refused connection would."""
+
+    def fetch(url, timeout):
+        for base, bodies in bodies_by_url.items():
+            if url.startswith(base + "/"):
+                return bodies[url[len(base):]]
+        raise OSError(f"connection refused: {url}")
+
+    return fetch
+
+
+def test_registry_autonames_and_repoints_slots():
+    reg = ProcessRegistry()
+    e0 = reg.add("http://h:1/")
+    assert e0.name == "proc0" and e0.url == "http://h:1"
+    e1 = reg.add("http://h:2", name="ps")
+    assert reg.add("http://h:3", name="ps") is e1  # same slot, re-pointed
+    assert e1.url == "http://h:3"
+    assert [e.name for e in reg.entries()] == ["proc0", "ps"]
+    assert len(reg) == 2
+
+
+def test_lifecycle_alive_stale_dead_alive_never_dropped():
+    text = ("# TYPE ps_push_total counter\n"
+            "ps_push_total 9\n")
+    bodies = {"http://ps": _fake_bodies(metrics_text=text)}
+    up = {"on": True}
+
+    def fetch(url, timeout):
+        if not up["on"]:
+            raise OSError("connection refused")
+        return _fake_fetch_factory(bodies)(url, timeout)
+
+    agg = FleetAggregator(dead_after=5.0, clock=lambda: 0.0, fetch=fetch)
+    entry = agg.add("http://ps", name="ps")
+    agg.poll(now=0.0)
+    assert entry.status == "alive"
+    up["on"] = False
+    agg.poll(now=1.0)
+    assert entry.status == "stale"  # within dead_after of the last ok
+    agg.poll(now=6.0)
+    assert entry.status == "dead"  # promoted, never removed
+    snap = agg.snapshot(now=6.0)
+    assert snap["status_counts"] == {"dead": 1}
+    # The dead process's last-known counters still contribute —
+    # dropping them would deflate fleet totals mid-outage.
+    assert snap["metrics"]["counters"]["ps_push_total"] == 9.0
+    assert snap["processes"]["ps"]["last_ok_s_ago"] == pytest.approx(6.0)
+    up["on"] = True
+    agg.poll(now=7.0)
+    assert entry.status == "alive"
+    assert [s for _, s in entry.transitions] == [
+        "alive", "stale", "dead", "alive"]
+
+
+def test_never_reachable_endpoint_goes_stale_then_dead():
+    agg = FleetAggregator(dead_after=2.0, clock=lambda: 0.0,
+                          fetch=_fake_fetch_factory({}))
+    entry = agg.add("http://nowhere", name="ghost")
+    agg.poll(now=0.0)
+    assert entry.status == "stale" and entry.last_error
+    agg.poll(now=3.0)  # dead_after from the first sighting of trouble
+    assert entry.status == "dead"
+    assert entry.last_ok is None
+
+
+# --------------------------------------------------------------------------
+# Live federation: three real OpsServers, real scrapes
+# --------------------------------------------------------------------------
+
+
+def _ops_server(role, registry, worker_id=None, boot=None):
+    return OpsServer(
+        port=0, registry=registry,
+        tracer=Tracer(annotate_device=False, enabled=False),
+        flight=FlightRecorder(capacity=4),
+        role=role, boot=boot, worker_id=worker_id,
+    ).start()
+
+
+def test_three_live_processes_merge_exactly():
+    """Satellite: ps + two workers scraped over real sockets. Summed
+    counters are exact; the bucket-wise histogram merge lands within
+    one bucket width of the exact pooled percentile (linear 1 ms
+    buckets, so 1.5e-3 abs — same tolerance ``test_obs`` pins for the
+    single-process estimate)."""
+    buckets = [i / 1000.0 for i in range(1, 101)]  # 1ms .. 100ms
+    regs = {name: MetricsRegistry() for name in ("ps", "w1", "w2")}
+    vals = {
+        "ps": [i / 1000.0 for i in range(1, 41)],
+        "w1": [i / 1000.0 for i in range(20, 80)],
+        "w2": [i / 1000.0 for i in range(50, 100)],
+    }
+    for name, reg in regs.items():
+        reg.counter("train_units_total", help="units").inc(
+            {"ps": 0, "w1": 4, "w2": 6}[name])
+        h = reg.histogram("ps_apply_seconds", buckets=buckets)
+        for v in vals[name]:
+            h.observe(v)
+    servers = {
+        "ps": _ops_server("ps", regs["ps"], boot="boot-ps"),
+        "w1": _ops_server("worker", regs["w1"], worker_id="w1"),
+        "w2": _ops_server("worker", regs["w2"], worker_id="w2"),
+    }
+    agg = FleetAggregator(dead_after=30.0)
+    try:
+        for name, srv in servers.items():
+            agg.add(srv.url, name=name)
+        tally = agg.poll()
+        assert tally == {"t": tally["t"], "ok": 3, "failed": 0}
+        snap = agg.snapshot()
+        assert snap["status_counts"] == {"alive": 3}
+        # /meta identity flowed into the roster.
+        assert snap["processes"]["ps"]["meta"]["role"] == "ps"
+        assert snap["processes"]["ps"]["meta"]["boot"] == "boot-ps"
+        assert snap["processes"]["w2"]["meta"]["worker_id"] == "w2"
+
+        merged = snap["metrics"]
+        assert merged["counters"]["train_units_total"] == 10.0
+        # Every process contributes its identity stamp, proc-tagged.
+        info = [k for k in merged["gauges"] if
+                k.startswith("elephas_process_info")]
+        assert len(info) == 3
+
+        pooled = sorted(vals["ps"] + vals["w1"] + vals["w2"])
+        h = merged["histograms"]["ps_apply_seconds"]
+        assert h["count"] == len(pooled)
+        assert h["sum"] == pytest.approx(sum(pooled))
+        assert sorted(h["procs"]) == ["ps", "w1", "w2"]
+        for q, key in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+            exact = trace_report.percentile(pooled, q)
+            assert h[key] == pytest.approx(exact, abs=1.5e-3), key
+    finally:
+        for srv in servers.values():
+            srv.stop()
+
+
+def test_live_kill_is_marked_stale_then_dead_then_alive():
+    """A stopped endpoint flips its roster entry stale → dead on the
+    aggregator's (injected) clock; remounting on the same port brings
+    the same slot back alive — the chaos_bench --fleet arc, in
+    milliseconds."""
+    reg = MetricsRegistry()
+    reg.counter("train_units_total", help="units").inc(2)
+    srv = _ops_server("ps", reg, boot="boot-a")
+    port = srv.port
+    now = {"t": 0.0}
+    agg = FleetAggregator(dead_after=5.0, clock=lambda: now["t"])
+    agg.add(srv.url, name="ps")
+    entry = agg.registry.get("ps")
+    agg.poll()
+    assert entry.status == "alive"
+
+    srv.stop()
+    now["t"] = 1.0
+    agg.poll()
+    assert entry.status == "stale"
+    now["t"] = 7.0
+    agg.poll()
+    assert entry.status == "dead"
+    # Dead, not gone: the merge still carries its last-known counters.
+    snap = agg.snapshot()
+    assert snap["metrics"]["counters"]["train_units_total"] == 2.0
+
+    srv2 = OpsServer(port=port, registry=reg,
+                     tracer=Tracer(annotate_device=False, enabled=False),
+                     flight=FlightRecorder(capacity=4),
+                     role="ps", boot="boot-b").start()
+    try:
+        now["t"] = 8.0
+        agg.poll()
+        assert entry.status == "alive"
+        assert entry.meta["boot"] == "boot-b"  # new incarnation, same slot
+        assert [s for _, s in entry.transitions] == [
+            "alive", "stale", "dead", "alive"]
+    finally:
+        srv2.stop()
+
+
+def test_concurrent_polls_under_writer_never_tear():
+    """The test_opsd hammer, one level up: parallel aggregator polls +
+    snapshots against live servers while writer threads mutate every
+    registry underneath. All polls succeed, every snapshot is
+    well-formed, and counters only move forward."""
+    regs = [MetricsRegistry() for _ in range(3)]
+    for reg in regs:
+        reg.counter("train_units_total", help="units")
+        reg.histogram("ps_apply_seconds", buckets=[0.01, 0.1, 1.0])
+    servers = [_ops_server("worker", reg, worker_id=f"w{i}")
+               for i, reg in enumerate(regs)]
+    agg = FleetAggregator(dead_after=30.0)
+    for i, srv in enumerate(servers):
+        agg.add(srv.url, name=f"w{i}")
+    stop = threading.Event()
+    errors = []
+
+    def writer(reg):
+        i = 0
+        while not stop.is_set():
+            reg.counter("train_units_total", help="units").inc()
+            reg.histogram("ps_apply_seconds",
+                          buckets=[0.01, 0.1, 1.0]).observe(0.05)
+            i += 1
+
+    def scraper():
+        last_total = 0.0
+        for _ in range(10):
+            try:
+                tally = agg.poll()
+                assert tally["failed"] == 0, tally
+                snap = agg.snapshot()
+                json.dumps(snap)  # the /fleet body must serialize
+                total = snap["metrics"]["counters"].get(
+                    "train_units_total", 0.0)
+                assert total >= last_total, (total, last_total)
+                last_total = total
+            except Exception as err:  # noqa: BLE001 - collected for assert
+                errors.append(repr(err))
+
+    writers = [threading.Thread(target=writer, args=(reg,), daemon=True)
+               for reg in regs]
+    scrapers = [threading.Thread(target=scraper, daemon=True)
+                for _ in range(3)]
+    try:
+        for t in writers + scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in scrapers)
+    finally:
+        stop.set()
+        for t in writers:
+            t.join(timeout=5)
+        for srv in servers:
+            srv.stop()
+    assert errors == []
+
+
+def test_fleet_route_serves_the_aggregators_snapshot():
+    """/fleet on the process hosting the aggregator serves the merged
+    view; an unwired process answers an empty roster, not a 404."""
+    import urllib.request
+
+    reg = MetricsRegistry()
+    reg.counter("train_units_total", help="units").inc(1)
+    member = _ops_server("worker", reg, worker_id="w0")
+    agg = FleetAggregator(dead_after=30.0)
+    agg.add(member.url, name="w0")
+    agg.poll()
+    host = OpsServer(port=0, registry=MetricsRegistry(),
+                     tracer=Tracer(annotate_device=False, enabled=False),
+                     flight=FlightRecorder(capacity=4),
+                     fleet_fn=agg.snapshot).start()
+    try:
+        with urllib.request.urlopen(f"{host.url}/fleet", timeout=5) as resp:
+            doc = json.loads(resp.read())
+        assert doc["status_counts"] == {"alive": 1}
+        assert doc["metrics"]["counters"]["train_units_total"] == 1.0
+        bare = OpsServer(port=0, registry=MetricsRegistry(),
+                         tracer=Tracer(annotate_device=False, enabled=False),
+                         flight=FlightRecorder(capacity=4)).start()
+        try:
+            with urllib.request.urlopen(f"{bare.url}/fleet",
+                                        timeout=5) as resp:
+                doc = json.loads(resp.read())
+            assert doc == {"polls": 0, "status_counts": {}, "processes": {}}
+        finally:
+            bare.stop()
+    finally:
+        host.stop()
+        member.stop()

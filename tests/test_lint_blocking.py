@@ -337,3 +337,66 @@ def test_registered_vocab_matches_runtime_tables():
 def test_cli_reports_clean(capsys):
     assert lint.main([]) == []
     assert "clean" in capsys.readouterr().out
+
+
+# -- route vocabulary (opsd route table) ------------------------------------
+
+
+def _pkg_root():
+    return Path(lint.__file__).resolve().parent.parent / "elephas_tpu"
+
+
+def test_route_vocab_matches_runtime_table():
+    """The AST-read ROUTES equals the importable constant, so the
+    lint's idea of the served surface can never drift from opsd's."""
+    from elephas_tpu.obs import opsd
+
+    assert lint.load_route_vocab(_pkg_root()) == opsd.ROUTES
+
+
+def test_package_and_scripts_route_registrations_conform():
+    """THE invariant: every add_route call site in the package and in
+    scripts/ uses a path from the registered vocabulary."""
+    scripts_dir = Path(lint.__file__).resolve().parent
+    assert lint.lint_route_package(_pkg_root(),
+                                   extra_roots=(scripts_dir,)) == []
+
+
+def test_route_lint_catches_each_form(tmp_path):
+    bad = tmp_path / "bad_routes.py"
+    bad.write_text(textwrap.dedent("""
+        def mount(self, srv, name):
+            self._add_route("/metrics", self._h_metrics)   # registered
+            self._add_route("/secret", self._h_secret)     # not in ROUTES
+            srv.add_route("/debug", handler)               # not in ROUTES
+            srv.add_route(f"/worker/{name}", handler)      # dynamic path
+            srv.add_route(name, handler)                   # variable: passes
+    """))
+    routes = lint.load_route_vocab(_pkg_root())
+    violations = lint.lint_route_file(bad, routes)
+    names = [v.call for v in violations]
+    assert names == ["`/secret` in _add_route()",
+                     "`/debug` in add_route()",
+                     "<f-string> in add_route()"]
+    assert all(v.domain == "route" for v in violations)
+    assert "obs.opsd.ROUTES" in str(violations[0])
+
+
+def test_route_pragma_exempts_a_line(tmp_path):
+    ok = tmp_path / "ok_routes.py"
+    ok.write_text(textwrap.dedent("""
+        def mount(srv):
+            srv.add_route("/test-hook", handler)  # route-ok: test-local
+            srv.add_route("/fleet", handler)
+    """))
+    routes = lint.load_route_vocab(_pkg_root())
+    assert lint.lint_route_file(ok, routes) == []
+
+
+def test_route_vocab_load_fails_loudly_without_table(tmp_path):
+    import pytest
+
+    (tmp_path / "obs").mkdir()
+    (tmp_path / "obs" / "opsd.py").write_text("SOMETHING_ELSE = 1\n")
+    with pytest.raises(RuntimeError, match="ROUTES"):
+        lint.load_route_vocab(tmp_path)

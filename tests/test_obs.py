@@ -876,3 +876,137 @@ def test_serving_metrics_mirror_itl_into_process_registry():
     snap = obs.default_registry().snapshot()
     assert snap["serving_itl_seconds_count"] == before + 1
     assert "serving_itl_seconds_p99" in snap
+
+
+# -- history rings (obs.history) --------------------------------------------
+
+
+def test_history_ring_wraps_capacity_and_keeps_newest():
+    from elephas_tpu.obs import HistoryRing
+
+    ring = HistoryRing(capacity=4)
+    for i in range(7):
+        ring.push(float(i), float(i * 10))
+    assert len(ring) == 4
+    # Oldest-first readout; wraparound drops the OLDEST samples.
+    assert ring.samples() == [(3.0, 30.0), (4.0, 40.0),
+                              (5.0, 50.0), (6.0, 60.0)]
+    assert ring.last() == (6.0, 60.0)
+    with pytest.raises(ValueError):
+        HistoryRing(capacity=1)  # a rate needs two points
+
+
+def test_history_ring_windowed_rate_on_injected_clock():
+    from elephas_tpu.obs import HistoryRing
+
+    ring = HistoryRing(capacity=16)
+    assert ring.rate(60.0, now=0.0) is None  # empty: never a made-up rate
+    ring.push(0.0, 0.0)
+    assert ring.rate(60.0, now=0.0) is None  # one point is not a rate
+    ring.push(10.0, 50.0)
+    ring.push(20.0, 150.0)
+    # Full window: (150 - 0) / (20 - 0).
+    assert ring.rate(60.0, now=20.0) == pytest.approx(7.5)
+    # Tight window excludes t=0: (150 - 50) / (20 - 10).
+    assert ring.rate(10.0, now=20.0) == pytest.approx(10.0)
+    # Window in the past relative to now: nothing retained inside it.
+    assert ring.rate(5.0, now=100.0) is None
+    stats = ring.stats(window_s=60.0, now=20.0)
+    assert stats["n"] == 3 and stats["last"] == 150.0
+    assert stats["min"] == 0.0 and stats["max"] == 150.0
+    assert stats["rate_per_s"] == pytest.approx(7.5)
+    assert stats["span_s"] == pytest.approx(20.0)
+    assert HistoryRing(capacity=4).stats() == {
+        "n": 0, "last": None, "min": None, "max": None,
+        "rate_per_s": None, "span_s": None}
+
+
+def test_history_sampler_selects_prefixes_on_injected_clock():
+    from elephas_tpu.obs import HistorySampler
+
+    reg = MetricsRegistry()
+    reg.counter("ps_push_total", help="pushes").inc(3)
+    reg.gauge("unrelated_depth", help="not sampled").set(9)
+    sampler = HistorySampler(registry=reg, period_s=1.0, capacity=8,
+                             clock=lambda: 0.0)
+    assert sampler.tick(now=0.0) == 1  # only the ps_ key matches
+    reg.counter("ps_push_total", help="pushes").inc(7)
+    assert sampler.maybe_tick(now=0.5) is False  # under period_s
+    assert sampler.maybe_tick(now=1.5) is True
+    assert set(sampler.rings) == {"ps_push_total"}
+    assert sampler.rings["ps_push_total"].rate(60.0, now=1.5) == \
+        pytest.approx(7 / 1.5)
+    snap = sampler.snapshot(window_s=60.0, now=1.5)
+    assert snap["ticks"] == 2 and snap["period_s"] == 1.0
+    assert snap["series"]["ps_push_total"]["last"] == 10.0
+
+
+def test_history_sampler_runs_extra_fn_and_survives_its_failure():
+    from elephas_tpu.obs import HistorySampler
+
+    reg = MetricsRegistry()
+    calls = []
+
+    def probe():
+        calls.append(1)
+        reg.gauge("device_mem_bytes", help="bytes",
+                  labelnames=("device",)).labels(device="cpu_0").set(4096)
+        if len(calls) > 1:
+            raise RuntimeError("runtime probe broke")
+
+    sampler = HistorySampler(registry=reg, extra_fn=probe,
+                             clock=lambda: 0.0)
+    assert sampler.tick(now=0.0) == 1  # the fresh gauge was sampled
+    assert sampler.tick(now=1.0) == 1  # probe raised; sampling continued
+    assert len(calls) == 2
+    key = 'device_mem_bytes{device="cpu_0"}'
+    assert sampler.rings[key].last() == (1.0, 4096.0)
+
+
+def test_alert_rate_rules_match_two_point_delta_reference():
+    """Pin the AlertEngine's HistoryRing migration: the windowed-rate
+    rules must produce the IDENTICAL fire sequence the original
+    two-point bookkeeping (oldest in-window point vs newest) produced —
+    replayed here as an inline reference next to the real engine."""
+    from elephas_tpu.obs import AlertEngine, AlertRule
+
+    rule = AlertRule("expiry_rate", "ps_worker_expired_total", ">", 0.5,
+                     kind="slo_breach", mode="rate", window_s=10.0, burn=2)
+    reg = MetricsRegistry()
+    counter = reg.counter("ps_worker_expired_total", help="probe")
+    engine = AlertEngine(registry=reg, flight=FlightRecorder(capacity=8),
+                         rules=[rule], clock=lambda: 0.0)
+
+    # Reference: the pre-migration semantics, as plain bookkeeping.
+    points = []
+    ref_fired = []
+    trips, breached = 0, False
+
+    def ref_eval(now, value):
+        nonlocal trips, breached
+        points.append((now, value))
+        live = [(t, v) for t, v in points if now - t <= rule.window_s]
+        if len(live) < 2 or live[-1][0] <= live[0][0]:
+            return
+        rate = (live[-1][1] - live[0][1]) / (live[-1][0] - live[0][0])
+        if rate <= rule.threshold:
+            trips, breached = 0, False
+            return
+        trips += 1
+        if trips >= rule.burn and not breached:
+            breached = True
+            ref_fired.append((now, round(rate, 9)))
+
+    # A burst (fires after burn=2), a quiet stretch (re-arms once the
+    # burst leaves the window), then a second burst (fires again).
+    script = [(0.0, 0), (2.0, 8), (4.0, 16), (6.0, 16), (20.0, 16),
+              (22.0, 16), (30.0, 16), (32.0, 28), (34.0, 40)]
+    for now, total in script:
+        counter._value = total
+        ref_eval(now, float(total))
+        engine.evaluate(now=now)
+
+    got = [(a["t"], round(a["value"], 9)) for a in engine.fired]
+    assert got == ref_fired
+    assert len(got) == 2  # both bursts fired, exactly once each
+    assert all(a["kind"] == "slo_breach" for a in engine.fired)

@@ -283,3 +283,193 @@ def test_routes_survive_concurrent_scrapes_while_registry_mutates():
         wt.join(timeout=5)
         server.stop()
     assert errors == []
+
+
+# -- fleet-era routes: /meta, /history, /profile, discoverable 404s ---------
+
+
+def test_meta_route_self_describes(ops):
+    """/meta is the federation handshake: identity plus the full served
+    route list, straight from the explicit route table."""
+    from elephas_tpu.obs.opsd import ROUTES
+
+    status, doc = _get_json(f"{ops.url}/meta")
+    assert status == 200
+    assert doc["role"] == "proc"  # fixture default
+    assert isinstance(doc["pid"], int)
+    assert doc["ops_port"] == ops.port
+    assert doc["routes"] == sorted(ROUTES)
+
+
+def test_meta_route_carries_identity():
+    from elephas_tpu.obs import FlightRecorder, MetricsRegistry, Tracer
+
+    server = OpsServer(port=0, registry=MetricsRegistry(),
+                       tracer=Tracer(annotate_device=False, enabled=False),
+                       flight=FlightRecorder(capacity=1),
+                       role="worker", boot="boot42", worker_id="w3")
+    server.start()
+    try:
+        _, doc = _get_json(f"{server.url}/meta")
+        assert doc["role"] == "worker"
+        assert doc["boot"] == "boot42"
+        assert doc["worker_id"] == "w3"
+    finally:
+        server.stop()
+
+
+def test_404_body_lists_known_routes(ops):
+    """A scraper with a typo learns the fix from the error itself."""
+    from elephas_tpu.obs.opsd import ROUTES
+
+    status, doc = _get_json(f"{ops.url}/metrcs")
+    assert status == 404
+    assert doc["path"] == "/metrcs"
+    assert doc["routes"] == sorted(ROUTES)
+
+
+def test_metrics_stamped_with_process_info_line():
+    from elephas_tpu.obs import FlightRecorder, MetricsRegistry, Tracer
+
+    server = OpsServer(port=0, registry=MetricsRegistry(),
+                       tracer=Tracer(annotate_device=False, enabled=False),
+                       flight=FlightRecorder(capacity=1),
+                       role="ps", boot="boot7")
+    server.start()
+    try:
+        import os
+
+        status, _, body = _get(f"{server.url}/metrics")
+        assert status == 200
+        text = body.decode()
+        assert "# TYPE elephas_process_info gauge" in text
+        assert (f'elephas_process_info{{role="ps",boot="boot7",'
+                f'pid="{os.getpid()}"}} 1') in text
+    finally:
+        server.stop()
+
+
+def test_history_route_serves_windowed_series(ops):
+    """An unwired process answers an empty shell (scrapers deploy
+    first); a wired one serves windowed stats from its sampler rings."""
+    from elephas_tpu.obs import (FlightRecorder, HistorySampler,
+                                 MetricsRegistry, Tracer)
+
+    status, doc = _get_json(f"{ops.url}/history")
+    assert status == 200
+    assert doc == {"period_s": None, "capacity": 0, "window_s": None,
+                   "ticks": 0, "series": {}}
+
+    reg = MetricsRegistry()
+    reg.counter("ps_push_total", help="pushes").inc(5)
+    sampler = HistorySampler(registry=reg, clock=lambda: 0.0)
+    sampler.tick(now=0.0)
+    reg.counter("ps_push_total", help="pushes").inc(5)
+    sampler.tick(now=2.0)
+    server = OpsServer(port=0, registry=reg,
+                       tracer=Tracer(annotate_device=False, enabled=False),
+                       flight=FlightRecorder(capacity=1), history=sampler)
+    server.start()
+    try:
+        status, doc = _get_json(f"{server.url}/history?window=60")
+        assert status == 200
+        assert doc["window_s"] == 60.0 and doc["ticks"] == 2
+        row = doc["series"]["ps_push_total"]
+        assert row["n"] == 2 and row["last"] == 10.0
+        assert row["rate_per_s"] == pytest.approx(2.5)
+    finally:
+        server.stop()
+
+
+def test_profile_route_drives_injected_profiler(tmp_path):
+    """The full remote capture protocol against a fake starter/stopper:
+    status → start → busy(409) → stop → idle, plus the unknown-action
+    400 — no jax involvement, just the lock protocol."""
+    from elephas_tpu.obs import FlightRecorder, MetricsRegistry, Tracer
+    from elephas_tpu.obs.devprof import DeviceProfiler
+
+    calls = []
+    prof = DeviceProfiler(out_dir=str(tmp_path / "prof"),
+                          starter=lambda d: calls.append(("start", d)),
+                          stopper=lambda: calls.append(("stop", None)))
+    server = OpsServer(port=0, registry=MetricsRegistry(),
+                       tracer=Tracer(annotate_device=False, enabled=False),
+                       flight=FlightRecorder(capacity=1), profiler=prof)
+    server.start()
+    try:
+        status, doc = _get_json(f"{server.url}/profile")
+        assert status == 200
+        assert doc["profiler"]["capturing"] is False
+        assert isinstance(doc["device_memory"], dict)
+
+        status, doc = _get_json(f"{server.url}/profile?action=start")
+        assert status == 200 and doc["status"] == "started"
+        assert calls == [("start", str(tmp_path / "prof"))]
+
+        # Second start while capturing: 409, never a stack trace.
+        status, doc = _get_json(f"{server.url}/profile?action=start")
+        assert status == 409 and doc["status"] == "busy"
+
+        status, doc = _get_json(f"{server.url}/profile?action=stop")
+        assert status == 200 and doc["status"] == "stopped"
+        assert doc["duration_s"] >= 0
+        status, doc = _get_json(f"{server.url}/profile?action=stop")
+        assert status == 200 and doc["status"] == "idle"
+
+        status, doc = _get_json(f"{server.url}/profile?action=reboot")
+        assert status == 400 and doc["actions"] == ["start", "stop"]
+        assert prof.captures == 1
+    finally:
+        server.stop()
+
+
+def test_profiler_error_surfaces_as_500():
+    from elephas_tpu.obs import FlightRecorder, MetricsRegistry, Tracer
+    from elephas_tpu.obs.devprof import DeviceProfiler
+
+    def broken(_d):
+        raise RuntimeError("no backend")
+
+    prof = DeviceProfiler(starter=broken, stopper=lambda: None)
+    server = OpsServer(port=0, registry=MetricsRegistry(),
+                       tracer=Tracer(annotate_device=False, enabled=False),
+                       flight=FlightRecorder(capacity=1), profiler=prof)
+    server.start()
+    try:
+        status, doc = _get_json(f"{server.url}/profile?action=start")
+        assert status == 500 and "no backend" in doc["error"]
+        # The capture lock was never taken: a fixed backend can retry.
+        assert prof.status()["capturing"] is False
+    finally:
+        server.stop()
+
+
+def test_trainer_mounts_worker_role_endpoint():
+    """AsyncTrainer.mount_ops gives the TRAINER process its own ops
+    endpoint (role worker) so the fleet sees both sides of an outage."""
+    from elephas_tpu import compile_model
+    from elephas_tpu.engine.async_engine import AsyncTrainer
+    from elephas_tpu.models import get_model
+    from elephas_tpu.parallel.mesh import build_mesh
+
+    net = compile_model(
+        get_model("mlp", features=(8,), num_classes=3),
+        optimizer={"name": "sgd", "learning_rate": 0.05},
+        loss="categorical_crossentropy", metrics=["acc"],
+        input_shape=(8,), seed=0,
+    )
+    trainer = AsyncTrainer(net, build_mesh(num_data=2), frequency="epoch")
+    ops = trainer.mount_ops()
+    try:
+        assert trainer.mount_ops() is ops  # idempotent
+        status, doc = _get_json(f"{ops.url}/meta")
+        assert status == 200
+        assert doc["role"] == "worker" and doc["worker_id"] == "w0"
+        status, doc = _get_json(f"{ops.url}/vars")
+        assert status == 200 and doc["frequency"] == "epoch"
+        # The worker's sampler thread is live; /history serves its shape.
+        status, doc = _get_json(f"{ops.url}/history")
+        assert status == 200 and doc["period_s"] == 1.0
+    finally:
+        trainer.unmount_ops()
+    assert trainer.ops is None
