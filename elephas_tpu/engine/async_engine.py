@@ -283,6 +283,8 @@ class AsyncTrainer:
         wal_every: int = 1,
         ps_recovery_grace: float = 15.0,
         ps_ops_port: Optional[int] = None,
+        ps_shards: Optional[int] = None,
+        standby: Optional[int] = None,
     ):
         """``pipelined_comms``: run each worker's PS traffic on a
         background comms thread (``_CommsPipeline``) — pushes become
@@ -343,7 +345,18 @@ class AsyncTrainer:
         the PS (wire transports): accepted pushes become durable before
         they are acked (at most ``wal_every - 1`` versions of lag) and a
         server constructed over the same directory warm-restarts from
-        the newest durable version."""
+        the newest durable version.
+
+        ``ps_shards``: shard the parameter tree across K wire-server
+        processes (``parameter.group.ShardGroup``) — workers scatter
+        pushes / gather pulls concurrently, so aggregate PS bandwidth
+        scales with K. Wire transports, single-host fits only (a
+        multi-host fit broadcasts ONE address; the group directory is
+        in-process). Default ``$ELEPHAS_PS_SHARDS`` or unsharded.
+        ``standby``: with ``ps_shards``, keep one WAL-streamed warm
+        spare per shard and promote it when the group's failure
+        detector declares a primary dead (requires ``ps_wal_dir``).
+        Default ``$ELEPHAS_PS_STANDBY`` or 0."""
         if frequency not in _FREQUENCIES:
             raise ValueError(
                 f"async frequency must be batch|epoch, got {frequency!r} "
@@ -377,6 +390,34 @@ class AsyncTrainer:
         # server.ops.port off the elastic chaos handle), plus this
         # worker process's own mountable ops endpoint (mount_ops()).
         self.ps_ops_port = ps_ops_port
+        import os
+
+        if ps_shards is None:
+            ps_shards = int(os.environ.get("ELEPHAS_PS_SHARDS", "0")) or None
+        if standby is None:
+            standby = int(os.environ.get("ELEPHAS_PS_STANDBY", "0"))
+        if ps_shards is not None:
+            if ps_shards < 1:
+                raise ValueError(f"ps_shards must be >= 1, got {ps_shards}")
+            if parameter_server_mode == "local":
+                raise ValueError(
+                    "ps_shards requires a wire transport (http|socket): "
+                    "shards are separate server processes"
+                )
+        if standby:
+            if not ps_shards:
+                raise ValueError(
+                    "standby is the shard group's hot-spare tier — set "
+                    "ps_shards (ps_shards=1 shards trivially) to use it"
+                )
+            if ps_wal_dir is None:
+                raise ValueError(
+                    "standby streams each shard's WAL to its spare — "
+                    "set ps_wal_dir"
+                )
+        self.ps_shards = ps_shards
+        self.standby = standby
+        self._elastic_group = None
         self.ops = None
         self._ops_history = None
         self._ops_alerts = None
@@ -606,6 +647,31 @@ class AsyncTrainer:
             self._ops_history.stop()
             self._ops_history = None
 
+    def _build_ps_group(self, store0, auth_key):
+        """Start the K-shard PS group (plus its standby tier and
+        failure monitor) this fit's workers will scatter/gather
+        against. Exposed on ``self._elastic_group`` for chaos tests."""
+        from elephas_tpu.parameter.group import ShardGroup
+
+        group = ShardGroup(
+            store0,
+            self.ps_shards,
+            mode=self.parameter_server_mode,
+            standby=self.standby,
+            wal_root=self.ps_wal_dir,
+            lock=self.lock,
+            device=jax.local_devices()[0],
+            granularity=self.granularity,
+            auth_key=auth_key,
+            wal_every=self.wal_every,
+            ops_port=self.ps_ops_port,
+        )
+        group.start()
+        if self.standby:
+            group.start_monitor()
+        self._elastic_group = group
+        return group
+
     def fit(
         self,
         dataset,
@@ -638,6 +704,12 @@ class AsyncTrainer:
                 "multi-host async/hogwild needs parameter_server_mode='http' "
                 "or 'socket' — the in-process buffer spans one host"
             )
+        if multi_host and self.ps_shards:
+            raise ValueError(
+                "ps_shards is single-host for now: the shard directory "
+                "lives in the driver process and multi-host fits "
+                "broadcast one PS address"
+            )
 
         # Reference topology (SURVEY.md §3.2): ONE parameter server on the
         # driver (host 0); every worker on every host dials it. Host 0
@@ -654,19 +726,26 @@ class AsyncTrainer:
             # key must get an AUTHENTICATED server — silently ignoring
             # the key would leave an open pickle endpoint.
             env_key = os.environ.get("ELEPHAS_PS_AUTH_KEY")
-            server = make_server(
-                self.parameter_server_mode,
-                store0,
-                lock=self.lock,
-                port=self.port,
-                device=jax.local_devices()[0],
-                granularity=self.granularity,
-                auth_key=bytes.fromhex(env_key) if env_key else None,
-                wal_dir=self.ps_wal_dir,
-                wal_every=self.wal_every,
-                ops_port=self.ps_ops_port,
-            )
-            server.start()
+            if self.ps_shards:
+                # ShardGroup quacks like a server here: start/stop/
+                # client()/get_parameters() — each worker's client()
+                # scatters/gathers across the K shard processes.
+                server = self._build_ps_group(
+                    store0, bytes.fromhex(env_key) if env_key else None)
+            else:
+                server = make_server(
+                    self.parameter_server_mode,
+                    store0,
+                    lock=self.lock,
+                    port=self.port,
+                    device=jax.local_devices()[0],
+                    granularity=self.granularity,
+                    auth_key=bytes.fromhex(env_key) if env_key else None,
+                    wal_dir=self.ps_wal_dir,
+                    wal_every=self.wal_every,
+                    ops_port=self.ps_ops_port,
+                )
+                server.start()
         else:
             import os
 
@@ -991,6 +1070,7 @@ class AsyncTrainer:
         else:
             final = jax.device_get(server.get_parameters())
             server.stop()
+            self._elastic_group = None
 
         # Master state from the server's final weights; metrics averaged
         # across workers per epoch.
@@ -1141,23 +1221,34 @@ class AsyncTrainer:
         store0 = {"params": compiled.params, "batch_stats": compiled.batch_stats}
         env_key = os.environ.get("ELEPHAS_PS_AUTH_KEY")
         auth_key = bytes.fromhex(env_key) if env_key else None
-        server = make_server(
-            self.parameter_server_mode,
-            store0,
-            lock=self.lock,
-            port=self.port,
-            device=jax.local_devices()[0],
-            granularity=self.granularity,
-            auth_key=auth_key,
-            wal_dir=self.ps_wal_dir,
-            wal_every=self.wal_every,
-            ops_port=self.ps_ops_port,
-        )
-        server.start()
+        if self.ps_shards:
+            server = self._build_ps_group(store0, auth_key)
+        else:
+            server = make_server(
+                self.parameter_server_mode,
+                store0,
+                lock=self.lock,
+                port=self.port,
+                device=jax.local_devices()[0],
+                granularity=self.granularity,
+                auth_key=auth_key,
+                wal_dir=self.ps_wal_dir,
+                wal_every=self.wal_every,
+                ops_port=self.ps_ops_port,
+            )
+            server.start()
         self._elastic_server = server
 
         mode = self.parameter_server_mode
-        if mode == "local":
+        if self.ps_shards:
+            def client_factory(worker_id):
+                # The group directory (not a fixed address) is the
+                # re-resolution point: after a shard failover the
+                # generation bump re-dials the promoted primary.
+                client = self._elastic_group.client()
+                client.worker_id = str(worker_id)
+                return client
+        elif mode == "local":
             def client_factory(worker_id):
                 # In-process: a PS "restart" is impossible (the buffer
                 # dies with this process), so always the live handle.
@@ -1345,21 +1436,34 @@ class AsyncTrainer:
         try:
             stats = pool.wait()
             # Final weights through the ADDRESS (the original server
-            # handle may be a corpse the chaos harness replaced).
-            final_client = client_factory("final")
-            try:
-                final = jax.device_get(final_client.get_parameters())
-            finally:
-                final_client.close()
+            # handle may be a corpse the chaos harness replaced). Rides
+            # an in-flight warm restart under the same grace budget the
+            # workers get: a fast fit can drain the ledger BEFORE a
+            # chaos kill lands, leaving this pull — the last wire op of
+            # the fit — to face the outage alone with only the client's
+            # ~3 s connect-retry budget.
+            deadline = time.monotonic() + self.ps_recovery_grace
+            while True:
+                final_client = client_factory("final")
+                try:
+                    final = jax.device_get(final_client.get_parameters())
+                    break
+                except ParameterServerUnavailable:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.1)
+                finally:
+                    final_client.close()
         finally:
             if injector is not None:
                 install(None)
             self._elastic_pool = None
             live = self._elastic_server
             self._elastic_server = None
+            self._elastic_group = None
             if live is not None:
                 try:
-                    live.stop()
+                    live.stop()  # a ShardGroup handle stops every member
                 except Exception:
                     pass
 

@@ -43,6 +43,7 @@ RULE_NAMES = (
     "worker_expiry_rate",
     "push_retry_rate",
     "serving_itl_p99_high",
+    "shard_failover_rate",
 )
 
 _PREDICATES = (">", "<")
@@ -121,6 +122,13 @@ def default_rules() -> List[AlertRule]:
         # Serving inter-token latency p99 (seconds).
         AlertRule("serving_itl_p99_high", "serving_itl_seconds_p99",
                   ">", 0.25, kind="slo_breach", severity="warn"),
+        # PS-group standby promotions per second: one failover is the
+        # mechanism working; a sustained rate means primaries are
+        # flapping (or the detector threshold is mis-set) and each
+        # promotion burns the shard's only spare.
+        AlertRule("shard_failover_rate", "ps_shard_failover_total",
+                  ">", 1 / 300.0, kind="shard_failover", mode="rate",
+                  window_s=600.0, severity="error"),
     ]
 
 

@@ -57,6 +57,10 @@ KINDS = (
     "slo_breach",
     "staleness_spike",
     "worker_lagging",
+    # shard-group lifecycle (parameter/group.py)
+    "shard_failover",
+    "standby_promoted",
+    "shard_map_mismatch",
 )
 
 

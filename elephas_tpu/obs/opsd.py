@@ -79,6 +79,7 @@ ROUTES = (
     "/history",
     "/profile",
     "/fleet",
+    "/shards",
 )
 
 
@@ -116,6 +117,9 @@ class OpsServer:
         (jax-backed, tempdir dumps) is created lazily on first use.
     fleet_fn: the ``/fleet`` payload (a ``FleetAggregator.snapshot``);
         empty roster when unset.
+    shards_fn: the ``/shards`` payload (a ``ShardGroup.snapshot`` —
+        plan digest, directory generation, standby lag, promotions);
+        empty doc when unset.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
@@ -127,7 +131,8 @@ class OpsServer:
                  workers_fn: Optional[Callable[[], Dict]] = None,
                  alerts_fn: Optional[Callable[[], Dict]] = None,
                  history=None, profiler=None,
-                 fleet_fn: Optional[Callable[[], Dict]] = None):
+                 fleet_fn: Optional[Callable[[], Dict]] = None,
+                 shards_fn: Optional[Callable[[], Dict]] = None):
         self._requested_port = port
         self.host = host if host is not None else _default_bind_host()
         self._registry = registry
@@ -143,6 +148,7 @@ class OpsServer:
         self._history = history
         self._profiler = profiler
         self._fleet_fn = fleet_fn
+        self._shards_fn = shards_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_wall = None
@@ -162,6 +168,7 @@ class OpsServer:
         self._add_route("/history", self._h_history)
         self._add_route("/profile", self._h_profile)
         self._add_route("/fleet", self._h_fleet)
+        self._add_route("/shards", self._h_shards)
 
     def _add_route(self, path: str, handler: Callable) -> None:
         self._routes[path] = handler
@@ -286,6 +293,12 @@ class OpsServer:
         if self._fleet_fn is not None:
             return 200, self._fleet_fn()
         return 200, {"polls": 0, "status_counts": {}, "processes": {}}
+
+    def _h_shards(self, query):
+        if self._shards_fn is not None:
+            return 200, self._shards_fn()
+        return 200, {"plan": None, "directory": None, "standbys": [],
+                     "promotions": []}
 
     def start(self) -> "OpsServer":
         if self._httpd is not None:

@@ -19,6 +19,12 @@ Wire payloads default to the packed zero-copy codec (``wire.py``:
 contiguous tensor region + small JSON header, version-gated not-modified
 replies, optional bf16/f16 delta quantization) with magic-byte
 negotiation back to the reference's pickle for legacy peers.
+
+``group.py`` scales the wire transports horizontally: a deterministic
+``ShardPlan`` partitions the tree across K server processes, a
+``ShardedParameterClient`` scatters/gathers concurrently, and a
+WAL-streamed warm standby per shard turns PS failover into a promotion
+(``ShardGroup``).
 """
 
 from elephas_tpu.parameter import wire  # noqa: F401
@@ -38,4 +44,14 @@ from elephas_tpu.parameter.client import (  # noqa: F401
     HttpClient,
     LocalClient,
     SocketClient,
+)
+from elephas_tpu.parameter.group import (  # noqa: F401
+    FencedPrimaryError,
+    GroupDirectory,
+    ShardGroup,
+    ShardGroupError,
+    ShardMapMismatch,
+    ShardPlan,
+    ShardedParameterClient,
+    WalStreamer,
 )

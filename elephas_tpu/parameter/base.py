@@ -60,5 +60,11 @@ class BaseParameterClient(abc.ABC):
         """Would a new request reach the server right now?"""
         return True
 
+    def shard_info(self):
+        """The server's shard-group identity (``{digest, shard, k,
+        boot}``), or None from a standalone (unsharded) server. The
+        sharded client's handshake verifies this before any transfer."""
+        return None
+
     def close(self) -> None:
         """Release any pooled transport state (idempotent)."""

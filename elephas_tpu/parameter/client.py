@@ -475,6 +475,14 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
     def deregister(self, worker_id: str) -> None:
         self._post(f"/deregister/{worker_id}", b"", "deregister")
 
+    def shard_info(self) -> Optional[dict]:
+        """``{digest, shard, k, boot}`` from a shard-group member, None
+        from a standalone server (404 on the pre-group route)."""
+        try:
+            return json.loads(self._get("/shardinfo", "shard_info"))
+        except RuntimeError:
+            return None
+
     def barrier_arrive(self, tag: str) -> int:
         return int(self._post(f"/barrier/{tag}", b"", "barrier_arrive"))
 
@@ -534,6 +542,10 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
             def attempt():
                 sock = socket.create_connection(self._addr, timeout=_CONNECT_TIMEOUT)
                 sock.settimeout(self.timeout)
+                # Strict request/reply framing: Nagle + delayed-ACK only
+                # adds ~40 ms stalls to small frames (version-gated pull
+                # requests, push acks).
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 return sock
 
             self._sock = _retry_connect(attempt, self.master_url, "connect")
@@ -659,6 +671,7 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
             sock = socket.create_connection(self._addr, timeout=_CONNECT_TIMEOUT)
             try:
                 sock.settimeout(_CONNECT_TIMEOUT)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 nonce = socket_utils.send(sock, ("c", "health"), key=self.auth_key)
                 socket_utils.receive(sock, key=self.auth_key, bind=nonce)
             finally:
@@ -681,6 +694,18 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
         # Also idempotent: deregistering an absent worker is a no-op.
         with self._lock:
             self._roundtrip(("d", worker_id), "deregister", idempotent=True)
+
+    def shard_info(self) -> Optional[dict]:
+        """``{digest, shard, k, boot}`` from a shard-group member. A
+        pre-group server closes the connection on the unknown frame kind,
+        which surfaces here as None (the handshake then reports "no
+        shard map" rather than a transport error)."""
+        with self._lock:
+            try:
+                return self._roundtrip(("i", None), "shard_info",
+                                       idempotent=True)
+            except ParameterServerUnavailable:
+                return None
 
     def barrier_arrive(self, tag: str) -> int:
         with self._lock:

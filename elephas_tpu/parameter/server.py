@@ -341,7 +341,7 @@ class _ObservableServerMixin:
         self.ops = OpsServer(
             port=self.ops_port,
             tracer=self.tracer,  # None → live process default
-            role="ps", boot=boot,
+            role=self.role, boot=boot,
             vars_fn=lambda: {"boot": boot, "version": buffer.version,
                              "transport": transport,
                              "ps_host": self.host, "ps_port": self.port},
@@ -350,6 +350,9 @@ class _ObservableServerMixin:
             alerts_fn=alerts.scrape,
             history=self._ops_history,
             profiler=self._ops_profiler,
+            # Group members get this stamped by ShardGroup (the group
+            # topology doc); standalone servers serve the empty shell.
+            shards_fn=getattr(self, "shards_fn", None),
         ).start()
 
     def _unmount_ops(self) -> None:
@@ -394,6 +397,8 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         heartbeat_timeout: Optional[float] = None,
         tracer=None,
         ops_port: Optional[int] = None,
+        role: str = "ps",
+        shard_info: Optional[dict] = None,
     ):
         """``auth_key``: shared HMAC-SHA256 secret. When set, every
         request must carry ``X-Elephas-Auth`` = hexmac(method + path +
@@ -421,7 +426,12 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         stays the client's while the boot id changes.
         ``ops_port``: mount an ``obs.opsd.OpsServer`` (loopback by
         default) on this port at ``start()`` — 0 picks a free port
-        (read ``.ops.port``)."""
+        (read ``.ops.port``).
+        ``role``: the ops/fleet role stamp (``ps`` standalone;
+        ``ps/shard<i>`` / ``ps/standby`` inside a group). ``shard_info``:
+        the group handshake doc (``{digest, shard, k}``) served from
+        ``GET /shardinfo`` with the live boot id merged in — unset means
+        the route 404s and sharded clients refuse this server."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
@@ -442,6 +452,8 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         # engine evaluated on every /alerts scrape.
         self.ledger = obs.StalenessLedger()
         self.alerts = obs.AlertEngine()
+        self.role = role
+        self.shard_info = shard_info
         self.flight_dump: Optional[str] = None
         self._wal_dir = wal_dir
         self._httpd = None
@@ -459,8 +471,13 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         cache_hits, bytes_tx, bytes_rx = _ps_counters("http")
         tracer_of = self._tracer
         ledger = self.ledger
+        shard_info = self.shard_info
 
         class Handler(BaseHTTPRequestHandler):
+            # Small replies (not-modified frames, barrier acks) must not
+            # stall behind Nagle + delayed-ACK coalescing.
+            disable_nagle_algorithm = True
+
             def log_message(self, *args):  # silence per-request stderr spam
                 pass
 
@@ -564,6 +581,15 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
                 elif path == "/membership":
                     self._reply(json.dumps(detector.membership()).encode(),
                                 content_type="application/json")
+                elif path == "/shardinfo":
+                    # Group handshake: the plan identity plus the LIVE
+                    # boot id (fencing compares boots, not addresses).
+                    if shard_info is None:
+                        self.send_error(404)
+                        return
+                    self._reply(
+                        json.dumps(dict(shard_info, boot=boot)).encode(),
+                        content_type="application/json")
                 elif path.startswith("/barrier/"):
                     self._reply(str(barriers.count(path[len("/barrier/"):])).encode())
                 else:
@@ -679,6 +705,11 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
 
 class _SocketHandler(socketserver.BaseRequestHandler):
     def handle(self):
+        # Nagle + delayed-ACK turns every small frame (12-byte
+        # not-modified replies, acks, shard-info) into a ~40 ms stall;
+        # the protocol is strict request/reply, so coalescing buys
+        # nothing.
+        self.request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         buffer = self.server.buffer  # type: ignore[attr-defined]
         barriers = self.server.barriers  # type: ignore[attr-defined]
         key = self.server.auth_key  # type: ignore[attr-defined]
@@ -689,6 +720,7 @@ class _SocketHandler(socketserver.BaseRequestHandler):
         wal_writer = self.server.wal_writer  # type: ignore[attr-defined]
         tracer_of = self.server.tracer_of  # type: ignore[attr-defined]
         ledger = self.server.ledger  # type: ignore[attr-defined]
+        shard_info = self.server.shard_info  # type: ignore[attr-defined]
         cache_hits, bytes_tx, bytes_rx = _ps_counters("socket")
         try:
             while True:
@@ -786,6 +818,9 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                     reply(barriers.arrive(payload))
                 elif kind == "c":  # barrier count(tag)
                     reply(barriers.count(payload))
+                elif kind == "i":  # shard-group handshake (live boot)
+                    reply(dict(shard_info, boot=boot)
+                          if shard_info is not None else None)
                 else:
                     break
         except (ConnectionError, OSError):
@@ -860,14 +895,18 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         heartbeat_timeout: Optional[float] = None,
         tracer=None,
         ops_port: Optional[int] = None,
+        role: str = "ps",
+        shard_info: Optional[dict] = None,
     ):
         """``auth_key``: shared HMAC-SHA256 secret — every frame in both
         directions carries a tag (nonce+timestamp under the MAC) verified
         before unpickling, and the server rejects replayed/stale nonces
         (see ``utils.sockets.send/receive``/``ReplayGuard``).
         ``wal_dir``/``wal_every``/``heartbeat_timeout``/``tracer``/
-        ``ops_port``: see ``HttpServer`` — identical durability,
-        liveness, and observability semantics."""
+        ``ops_port``/``role``/``shard_info``: see ``HttpServer`` —
+        identical durability, liveness, observability, and shard-group
+        handshake semantics (here the handshake is the ``('i', None)``
+        frame)."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
@@ -886,6 +925,8 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         # See HttpServer: staleness ledger + SLO alert engine.
         self.ledger = obs.StalenessLedger()
         self.alerts = obs.AlertEngine()
+        self.role = role
+        self.shard_info = shard_info
         self.flight_dump: Optional[str] = None
         self._wal_dir = wal_dir
         self._server = None
@@ -903,6 +944,7 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         self._server.wal_writer = self.wal_writer  # type: ignore[attr-defined]
         self._server.tracer_of = self._tracer  # type: ignore[attr-defined]
         self._server.ledger = self.ledger  # type: ignore[attr-defined]
+        self._server.shard_info = self.shard_info  # type: ignore[attr-defined]
         if self.port == 0:
             self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
@@ -958,6 +1000,8 @@ def make_server(
     heartbeat_timeout: Optional[float] = None,
     tracer=None,
     ops_port: Optional[int] = None,
+    role: str = "ps",
+    shard_info: Optional[dict] = None,
 ) -> BaseParameterServer:
     """Factory keyed on the reference's ``parameter_server_mode``.
     ``granularity`` ('tree'|'leaf') sets the hogwild apply isolation —
@@ -969,13 +1013,20 @@ def make_server(
     training job the WAL would resume into). ``tracer``/``ops_port``:
     server-side handle spans and the mountable ops endpoint (wire
     transports; the local server shares the workers' process-global
-    tracer already)."""
+    tracer already). ``role``/``shard_info``: the fleet role stamp and
+    shard-group handshake doc (``parameter.group`` passes these; a
+    standalone server keeps the defaults)."""
     if mode == "local":
         if wal_dir is not None:
             raise ValueError(
                 "wal_dir requires a wire transport (http|socket): the local "
                 "server dies with the training process it would be "
                 "restarted for"
+            )
+        if shard_info is not None:
+            raise ValueError(
+                "shard_info requires a wire transport (http|socket): shard "
+                "group members are separate server processes"
             )
         return LocalServer(params, lock=lock, device=device, granularity=granularity,
                            heartbeat_timeout=heartbeat_timeout)
@@ -984,11 +1035,13 @@ def make_server(
                           granularity=granularity, auth_key=auth_key,
                           wal_dir=wal_dir, wal_every=wal_every,
                           heartbeat_timeout=heartbeat_timeout,
-                          tracer=tracer, ops_port=ops_port)
+                          tracer=tracer, ops_port=ops_port,
+                          role=role, shard_info=shard_info)
     if mode == "socket":
         return SocketServer(params, lock=lock, port=port, device=device, host=host,
                             granularity=granularity, auth_key=auth_key,
                             wal_dir=wal_dir, wal_every=wal_every,
                             heartbeat_timeout=heartbeat_timeout,
-                            tracer=tracer, ops_port=ops_port)
+                            tracer=tracer, ops_port=ops_port,
+                            role=role, shard_info=shard_info)
     raise ValueError(f"parameter_server_mode must be local|http|socket, got {mode!r}")

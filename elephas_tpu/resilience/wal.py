@@ -82,6 +82,17 @@ class SnapshotWAL:
         versions = self.versions()
         return versions[-1] if versions else None
 
+    def versions_after(self, version: Optional[int]) -> List[int]:
+        """Durable snapshot versions strictly newer than ``version``,
+        ascending — the tail a streaming follower has not applied yet.
+        ``None`` means "nothing applied": the full durable history.
+        With ``wal_every > 1`` the version counter is sparse on disk, so
+        this is the honest unapplied-snapshot count where a plain
+        ``latest - applied`` difference over-reports the lag."""
+        if version is None:
+            return self.versions()
+        return [v for v in self.versions() if v > version]
+
     def append(self, tree, version: int) -> Path:
         """Durably persist ``tree`` tagged with ``version``.
 

@@ -47,7 +47,8 @@ from typing import Dict, List, Optional, Tuple
 # Each kind: (key_fields, [(metric, direction, tolerance)]).
 # direction: "higher" — fresh >= base*(1-tol); "lower" — fresh <=
 # base*(1+tol); "equal" — exact match; "limit" — fresh <= tol (absolute,
-# baseline ignored).
+# baseline ignored); "floor" — fresh >= tol (absolute lower bound, the
+# mirror of "limit").
 RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
     "serve": (
         ("mode", "pipeline"),
@@ -70,6 +71,13 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             ("secs_per_unit", "lower", 0.75),
             ("speedup", "higher", 0.50),
             ("ratio", "higher", 0.50),
+            # Shard-group scaling guardrail: the K=4 single-shard-dirty
+            # refresh must deliver at least 2x the K=1 effective view
+            # bandwidth. Absolute floor — the win is byte economy
+            # (K-1 shards answer not-modified), so it holds on any host
+            # regardless of core count; a drop below 2 means per-shard
+            # version gating or the scatter/gather path broke.
+            ("ps_shard_bw_ratio", "floor", 2.0),
         ],
     ),
     "chaos": (
@@ -95,6 +103,15 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             # Replay-stable outage visibility: the kill_ps fleet row
             # must show the full alive→stale→dead→alive arc.
             ("fleet_saw_outage", "equal", 0.0),
+            # Shard-kill row (--shards): wall seconds from killing a
+            # shard primary to the first successful pull through the
+            # re-resolved client. Absolute ceiling, sized as detection
+            # (dead_after ≈ 2x suspect_after) + one exhausted client
+            # retry budget (~2.8 s) with generous CI headroom.
+            ("shard_failover_mttr_s", "limit", 10.0),
+            # Zero acked-update loss: the post-promotion pull must equal
+            # the last tree the dead primary acked, replay-stably.
+            ("acked_state_recovered", "equal", 0.0),
         ],
     ),
 }
@@ -121,6 +138,8 @@ def _check(metric: str, direction: str, tol: float,
         return fresh == base, f"must equal {base!r}"
     if direction == "limit":
         return float(fresh) <= tol, f"must be <= {tol}"
+    if direction == "floor":
+        return float(fresh) >= tol, f"must be >= {tol}"
     if direction == "higher":
         floor = float(base) * (1.0 - tol)
         return float(fresh) >= floor, f"must be >= {floor:.6g}"

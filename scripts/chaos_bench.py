@@ -48,6 +48,19 @@ histogram) plus the deterministic fake-clock ``alert_ladder`` sequence
 ``test_chaos.py`` and gated by ``bench_gate.py``'s ``staleness_p95``
 rule.
 
+``--shards`` appends a ``{"scenario": "shard_kill"}`` row: a K=2
+``ShardGroup`` with one warm standby per shard takes a seeded push
+sequence, shard 0's primary is crashed (``kill``: no clean WAL sync),
+and the group monitor promotes the WAL-streamed spare. The row commits
+the measured ``shard_failover_mttr_s`` (wall seconds from kill to the
+first successful pull through the re-resolved client — detection +
+promotion + client re-dial, the number an operator sees),
+``acked_state_recovered`` (the post-promotion pull is digest-identical
+to the last acked state: zero acked-update loss), and the replay-stable
+``final_digest`` (same seed → same digest on every run; a replay that
+drifts changed the data path). Both gated by ``bench_gate.py``
+(``shard_failover_mttr_s`` ceiling, ``acked_state_recovered`` equal).
+
 ``--fleet`` appends a ``{"scenario": "fleet"}`` row: the kill_ps chaos
 arm re-run with ops endpoints mounted on BOTH sides (the elastic PS via
 ``ps_ops_port``, the trainer process via ``mount_ops``) and a
@@ -463,6 +476,82 @@ def scenario_health(x, y, epochs, seed: int = 11):
     )
 
 
+def scenario_shard_kill(seed: int = 11, k: int = 2, updates: int = 6):
+    """``--shards``: kill a shard primary under a seeded push sequence
+    and measure the standby promotion end to end. Runs the ShardGroup
+    directly (no training loop): the seeded deltas make the final tree
+    — and therefore ``final_digest`` — bit-replayable, so the committed
+    digest doubles as a data-path regression check."""
+    import hashlib
+
+    import jax
+
+    from elephas_tpu.parameter.group import ShardGroup
+
+    def digest(tree):
+        h = hashlib.sha256()
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        for path, leaf in sorted(leaves, key=lambda kv: str(kv[0])):
+            h.update(str(path).encode())
+            h.update(np.ascontiguousarray(leaf).tobytes())
+        return h.hexdigest()[:16]
+
+    net = _build_net()
+    store = jax.device_get({"params": net.params,
+                            "batch_stats": net.batch_stats})
+    rng = np.random.default_rng(seed)
+
+    with tempfile.TemporaryDirectory() as wal_root:
+        group = ShardGroup(store, k, mode="socket", standby=1,
+                           wal_root=wal_root, suspect_after=0.3)
+        group.start()
+        client = group.client()
+        try:
+            for _ in range(updates):
+                delta = jax.tree_util.tree_map(
+                    lambda a: rng.normal(
+                        scale=0.01, size=np.shape(a)
+                    ).astype(np.asarray(a).dtype), store)
+                client.update_parameters(delta)
+            acked = client.get_parameters()
+            acked_digest = digest(acked)
+            # Spares must be caught up before the kill — the WAL made
+            # every acked update durable (wal_every=1), the streamer
+            # just needs to have applied it.
+            deadline = time.perf_counter() + 10.0
+            while any(group.streamer_of(i).lag()
+                      for i in range(k)) and time.perf_counter() < deadline:
+                time.sleep(0.01)
+
+            group.start_monitor(interval=0.05)
+            t0 = time.perf_counter()
+            group.kill_primary(0)
+            after = None
+            while after is None and time.perf_counter() - t0 < 60.0:
+                try:
+                    after = client.get_parameters()
+                except Exception:
+                    time.sleep(0.02)
+            mttr = time.perf_counter() - t0
+            promo = group.promotions[-1] if group.promotions else {}
+            return {
+                "scenario": "shard_kill", "shards": k, "standby": 1,
+                "updates_acked": updates,
+                "shard_failover_mttr_s": round(mttr, 3),
+                "promote_s": round(promo.get("promote_s", -1.0), 4),
+                "caught_up_version": promo.get("caught_up_version"),
+                "old_boot_fenced": group.directory.is_fenced(
+                    promo.get("old_boot")),
+                "acked_state_recovered": (after is not None
+                                          and digest(after) == acked_digest),
+                "final_digest": acked_digest,
+                "seed": seed,
+            }
+        finally:
+            client.close()
+            group.stop()
+
+
 def export_role_dumps(tracer, outdir, prefix="chaos_trace"):
     """Split the in-process span ring into the per-role dumps a real
     deployment would collect from each process's ``/trace`` route:
@@ -508,6 +597,10 @@ def main(argv=None):
                          "per-unit critical-path table")
     ap.add_argument("--trace-dir", default=".",
                     help="where --trace writes its three JSON artifacts")
+    ap.add_argument("--shards", action="store_true",
+                    help="append the shard-kill row: K=2 ShardGroup with "
+                         "warm standbys, one primary crashed, measured "
+                         "promotion MTTR + zero-acked-loss digest check")
     ap.add_argument("--fleet", action="store_true",
                     help="append the federation row: kill_ps observed "
                          "through a FleetAggregator polling the PS and "
@@ -531,6 +624,8 @@ def main(argv=None):
     rows.append(scenario_partition(x, y, args.epochs))
     if args.health:
         rows.append(scenario_health(x, y, args.epochs, seed=args.seed))
+    if args.shards:
+        rows.append(scenario_shard_kill(seed=args.seed))
     if args.fleet:
         rows.append(scenario_fleet(x, y, args.epochs, args.outage))
 

@@ -42,13 +42,15 @@ def render(snap: dict) -> str:
     lines.append(f"fleet: {len(snap['processes'])} processes  {summary}"
                  f"  polls={snap['polls']}")
     lines.append("")
-    lines.append(f"{'NAME':<10} {'ROLE':<8} {'STATUS':<7} {'BOOT':<14} "
+    # ROLE is 12 wide: shard-group members report differentiated roles
+    # ("ps/shard0", "ps/standby"), not just the flat "ps"/"worker".
+    lines.append(f"{'NAME':<10} {'ROLE':<12} {'STATUS':<7} {'BOOT':<14} "
                  f"{'WORKER':<8} {'LAST OK':>8}  URL")
     for name, p in sorted(snap["processes"].items()):
         meta = p.get("meta") or {}
         ago = p.get("last_ok_s_ago")
         lines.append(
-            f"{name:<10} {str(meta.get('role', '?')):<8} "
+            f"{name:<10} {str(meta.get('role', '?')):<12} "
             f"{p['status']:<7} {str(meta.get('boot', ''))[:14]:<14} "
             f"{str(meta.get('worker_id') or '-'):<8} "
             f"{('%.1fs' % ago) if ago is not None else '-':>8}  {p['url']}"

@@ -23,12 +23,26 @@ artifact (``--out BENCH_PS.json``):
   fixed sleep) against a live server: the serial arm pays
   train+wire per unit, the pipelined arm overlaps them via
   ``_CommsPipeline`` prefetch + fire-and-forget push.
+- ``{"mode": "shards", "op": "pull_k<K>" | "push_k<K>" |
+  "refresh_k<K>"}`` — the ShardGroup data path at K=1/2/4 socket
+  shards (K is baked into ``op`` so the gate's identity key separates
+  the arms). ``pull``/``push`` are dense full-tree scatter/gather;
+  ``refresh`` is the single-shard-dirty cycle: one shard's version
+  advances and a worker re-pulls its full consistent view. Dense arms
+  track fan-out overhead (on a loopback single process they cannot
+  show parallel speedup — every shard shares the host's cores); the
+  refresh arm carries the scaling claim that IS core-independent:
+  per-shard version gating means the K-1 clean shards answer with
+  12-byte not-modified frames, so the effective full-view refresh
+  bandwidth grows ~K×. The K=4 refresh row's ``ps_shard_bw_ratio``
+  (vs the K=1 refresh arm) is held above an absolute floor by
+  ``bench_gate.py``.
 
 Importable (and runnable with tiny defaults) without a TPU — wire+codec
 paths are pure numpy/sockets; real numbers come from the dev host.
 
 Usage: python scripts/ps_bench.py [--reps 5] [--units 30]
-       [--train-ms 25] [--small] [--out BENCH_PS.json]
+       [--train-ms 25] [--small] [--shards] [--out BENCH_PS.json]
 """
 
 from __future__ import annotations
@@ -238,6 +252,66 @@ def bench_pipeline(tree, units: int, train_ms: float):
     return rows
 
 
+def bench_shards(tree, reps: int, shard_counts=(1, 2, 4)):
+    """ShardGroup data path: dense scatter/gather + sparse refresh.
+
+    Per K: one live socket group, one sharded client. ``pull``/``push``
+    bump every shard first (no arm hides behind the not-modified cache)
+    and move the whole tree — the fan-out overhead rows. ``refresh``
+    advances ONE shard's version and re-pulls the full consistent view:
+    the K-1 clean shards answer 12-byte not-modified frames, so the
+    bytes on the wire shrink ~K× and the effective view-refresh
+    bandwidth (full tree MB per refresh second) grows with K on any
+    host — byte economy, not parallelism, which is why THIS row carries
+    the gated ``ps_shard_bw_ratio``.
+    """
+    from elephas_tpu.parameter.group import ShardGroup
+
+    mb = tree_nbytes(tree) / 1e6
+    rows = []
+    bw = {}
+    for k in shard_counts:
+        group = ShardGroup(tree, k, mode="socket")
+        group.start()
+        try:
+            client = group.client()
+            client.get_parameters()  # prime dials + snapshot caches
+
+            def pull():
+                for i in range(k):
+                    group.primary(i).buffer._version += 1
+                client.get_parameters()
+
+            def push():
+                client.update_parameters(tree)
+
+            def refresh():
+                group.primary(0).buffer._version += 1
+                client.get_parameters()
+
+            for op, fn in (("pull", pull), ("push", push),
+                           ("refresh", refresh)):
+                secs = _time(fn, reps)
+                bw[(op, k)] = mb / secs
+                row = {
+                    "mode": "shards", "codec": "packed", "op": f"{op}_k{k}",
+                    "quantize": None, "pipelined": None, "shards": k,
+                    "tree_mb": round(mb, 2), "secs": secs,
+                    "mb_per_s": round(mb / secs, 1),
+                }
+                if k != 1 and (op, 1) in bw:
+                    row["shard_bw_ratio"] = round(bw[(op, k)] / bw[(op, 1)],
+                                                  2)
+                if op == "refresh" and k == max(shard_counts) \
+                        and ("refresh", 1) in bw:
+                    row["ps_shard_bw_ratio"] = row["shard_bw_ratio"]
+                rows.append(row)
+            client.close()
+        finally:
+            group.stop()
+    return rows
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--reps", type=int, default=5)
@@ -245,6 +319,9 @@ def main(argv=None):
     ap.add_argument("--train-ms", type=float, default=25.0)
     ap.add_argument("--small", action="store_true",
                     help="1/8-width tree (tier-1 smoke)")
+    ap.add_argument("--shards", action="store_true",
+                    help="append the ShardGroup aggregate-bandwidth arm "
+                         "(K=1/2/4 socket shards)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
@@ -257,6 +334,8 @@ def main(argv=None):
     rows += bench_cache(tree, args.reps)
     rows += bench_transport(tree, args.reps)
     rows += bench_pipeline(tree, args.units, args.train_ms)
+    if args.shards:
+        rows += bench_shards(tree, args.reps)
 
     for row in rows:
         print(json.dumps(row))

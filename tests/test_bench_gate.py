@@ -211,3 +211,47 @@ def test_fleet_rules_gate_scrape_cost_and_outage_visibility():
         "must be <= 150.0"
     assert by[("fleet", "fleet_merge_ms_mean")]["threshold"] == \
         "must be <= 50.0"
+
+
+def test_floor_direction_is_absolute_lower_bound():
+    """ps_shard_bw_ratio: the K=4 refresh arm's effective-bandwidth
+    ratio over K=1 has an absolute floor — a fresh value above the
+    committed baseline still fails if it drops under 2x, because the
+    claim is byte economy (K-1 not-modified shards), not a number that
+    should drift with the host."""
+    base = [{"mode": "shards", "codec": "packed", "op": "refresh_k4",
+             "quantize": None, "pipelined": None,
+             "mb_per_s": 500.0, "ps_shard_bw_ratio": 3.8}]
+    good = bg.compare(base, [dict(base[0], ps_shard_bw_ratio=2.1)], "ps")
+    assert all(c["ok"] for c in good)
+    bad = bg.compare(base, [dict(base[0], ps_shard_bw_ratio=1.4)], "ps")
+    failed = [c for c in bad if not c["ok"]]
+    assert [c["metric"] for c in failed] == ["ps_shard_bw_ratio"]
+    assert failed[0]["threshold"] == "must be >= 2.0"
+    # Dense pull/push shard rows don't carry the ratio → untouched.
+    dense = [{"mode": "shards", "codec": "packed", "op": "pull_k4",
+              "quantize": None, "pipelined": None, "mb_per_s": 600.0}]
+    by = _checks_by_metric(bg.compare(dense, dense, "ps"))
+    assert ("shards/packed/pull_k4", "ps_shard_bw_ratio") not in by
+
+
+def test_shard_kill_rules_gate_mttr_and_acked_loss():
+    """The --shards chaos row: promotion MTTR is an absolute ceiling
+    (detection + one client retry budget + CI headroom), and
+    acked_state_recovered is exact — any acked-update loss after a
+    promotion fails the gate no matter how fast it was."""
+    base = [{"scenario": "shard_kill", "shard_failover_mttr_s": 2.8,
+             "acked_state_recovered": True}]
+    slow_but_ok = bg.compare(base, [
+        {"scenario": "shard_kill", "shard_failover_mttr_s": 9.5,
+         "acked_state_recovered": True}], "chaos")
+    assert all(c["ok"] for c in slow_but_ok)
+    bad = bg.compare(base, [
+        {"scenario": "shard_kill", "shard_failover_mttr_s": 11.0,
+         "acked_state_recovered": False}], "chaos")
+    failed = sorted((c["key"], c["metric"]) for c in bad if not c["ok"])
+    assert failed == [("shard_kill", "acked_state_recovered"),
+                      ("shard_kill", "shard_failover_mttr_s")]
+    by = _checks_by_metric(bad)
+    assert by[("shard_kill", "shard_failover_mttr_s")]["threshold"] == \
+        "must be <= 10.0"

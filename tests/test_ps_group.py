@@ -1,0 +1,384 @@
+"""Sharded PS group: plan partitioning, scatter/gather, WAL-streamed
+hot standby, and failover promotion (`elephas_tpu.parameter.group`).
+
+Plan/directory/streamer units run in-process; the scatter/gather and
+promotion tests boot real wire servers on port 0. Promotion lifecycles
+are driven two ways: `check()` on a fake clock (deterministic), and a
+live monitor-thread kill test (the integration proof).
+"""
+
+import hashlib
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from elephas_tpu import obs
+from elephas_tpu.parameter import (
+    FencedPrimaryError,
+    GroupDirectory,
+    ShardGroup,
+    ShardGroupError,
+    ShardMapMismatch,
+    ShardPlan,
+    ShardedParameterClient,
+    WalStreamer,
+)
+from elephas_tpu.parameter.buffer import ParameterBuffer
+from elephas_tpu.parameter.server import SocketServer, make_server
+from elephas_tpu.resilience import SnapshotWAL
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def _params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense1": {"kernel": rng.normal(size=(8, 16)).astype(np.float32),
+                   "bias": np.zeros(16, np.float32)},
+        "dense2": {"kernel": rng.normal(size=(16, 4)).astype(np.float32),
+                   "bias": np.zeros(4, np.float32)},
+        "scale": np.ones((3,), np.float32),
+    }
+
+
+def _delta(seed):
+    rng = np.random.default_rng(seed)
+    return jax.tree_util.tree_map(
+        lambda x: rng.normal(scale=0.01, size=x.shape).astype(x.dtype),
+        _params(),
+    )
+
+
+def _tree_digest(tree) -> str:
+    """Value digest over the sorted-path flattening (order-canonical)."""
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in sorted(leaves, key=lambda kv: str(kv[0])):
+        h.update(str(path).encode())
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# --------------------------------------------------------------------------
+# ShardPlan: determinism, balance, canonical digest, path-keyed split
+# --------------------------------------------------------------------------
+
+
+def test_plan_is_deterministic_and_balanced():
+    a = ShardPlan.build(_params(), 2)
+    b = ShardPlan.build(_params(), 2)
+    assert a.digest == b.digest
+    assert a.shard_of == b.shard_of
+    assert a.paths == b.paths
+    # Every shard owns at least one leaf, and the greedy LPT bin-pack
+    # keeps the byte spread within one largest-leaf of even.
+    loads = [0] * a.k
+    for i, shard in enumerate(a.shard_of):
+        loads[shard] += a.rows[i][2]
+    assert all(load > 0 for load in loads)
+    assert max(loads) - min(loads) <= max(r[2] for r in a.rows)
+
+
+def test_plan_digest_canonical_under_jax_tree_rebuild():
+    """jax tree ops rebuild dicts in sorted-key order; the plan digest
+    must not depend on insertion order or the two sides of the
+    handshake could never agree."""
+    params = _params()
+    sorted_copy = jax.tree_util.tree_map(lambda x: x, params)
+    assert ShardPlan.build(params, 2).digest == \
+        ShardPlan.build(sorted_copy, 2).digest
+
+
+def test_split_is_path_keyed_not_positional():
+    """A delta whose dict ordering differs from the plan's build order
+    (the tree_map case) must still land every leaf on the right shard
+    under the right path."""
+    params = _params()
+    plan = ShardPlan.build(params, 2)
+    reordered = jax.tree_util.tree_map(lambda x: x, params)
+    merged = plan.merge(plan.split(reordered))
+    for (pa, la), (pb, lb) in zip(
+            jax.tree_util.tree_flatten_with_path(params)[0],
+            jax.tree_util.tree_flatten_with_path(merged)[0]):
+        assert str(pa) == str(pb)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_split_rejects_a_different_tree():
+    plan = ShardPlan.build(_params(), 2)
+    other = _params()
+    other["dense3"] = {"kernel": np.zeros((2, 2), np.float32)}
+    with pytest.raises(ShardMapMismatch):
+        plan.split(other)
+    del other["dense3"], other["dense1"]
+    with pytest.raises(ShardMapMismatch):
+        plan.split(other)
+
+
+def test_plan_build_validations():
+    with pytest.raises(ValueError):
+        ShardPlan.build(_params(), 0)
+    with pytest.raises(ValueError):
+        ShardPlan.build(_params(), 99)  # more shards than leaves
+
+
+# --------------------------------------------------------------------------
+# GroupDirectory
+# --------------------------------------------------------------------------
+
+
+def test_directory_publish_fence_generation():
+    d = GroupDirectory("abc", 2)
+    assert d.generation == 0
+    with pytest.raises(ShardGroupError):
+        d.address_of(0)
+    d.publish(0, "127.0.0.1:1", "boot-a")
+    d.publish(1, "127.0.0.1:2", "boot-b")
+    assert d.generation == 2
+    assert d.address_of(1) == "127.0.0.1:2"
+    assert not d.is_fenced("boot-a")
+    d.fence("boot-a")
+    assert d.is_fenced("boot-a")
+    snap = d.snapshot()
+    assert snap["fenced"] == ["boot-a"]
+    assert snap["digest"] == "abc"
+
+
+# --------------------------------------------------------------------------
+# WalStreamer
+# --------------------------------------------------------------------------
+
+
+def test_wal_streamer_tails_and_catches_up(tmp_path):
+    wal = SnapshotWAL(str(tmp_path))
+    spare = ParameterBuffer(_params(), lock=True)
+    streamer = WalStreamer(wal, spare)
+    assert streamer.poll_once() is None  # empty WAL: nothing to apply
+    assert streamer.lag() == 0
+    tree = _params(seed=7)
+    wal.append(tree, 3)
+    assert streamer.lag() == 1
+    assert streamer.poll_once() == 3
+    assert streamer.applied_version == 3
+    assert streamer.lag() == 0
+    np.testing.assert_array_equal(
+        spare.get_numpy()["dense1"]["kernel"], tree["dense1"]["kernel"])
+    # stop(catch_up=True) applies the final durable snapshot and
+    # reports the promotion floor.
+    wal.append(_params(seed=8), 5)
+    assert streamer.stop(catch_up=True) == 5
+
+
+def test_wal_versions_after(tmp_path):
+    wal = SnapshotWAL(str(tmp_path), keep=10)
+    for v in (2, 5, 9):
+        wal.append(_params(), v)
+    assert wal.versions_after(None) == [2, 5, 9]
+    assert wal.versions_after(2) == [5, 9]
+    assert wal.versions_after(9) == []
+
+
+# --------------------------------------------------------------------------
+# Scatter/gather over live wire servers
+# --------------------------------------------------------------------------
+
+
+def test_scatter_gather_matches_single_ps():
+    """The headline equivalence: the same seeded push sequence through
+    a K=2 group and a single PS must land on digest-identical trees."""
+    params = _params()
+    single = make_server("socket", params, lock=True, port=0)
+    group = ShardGroup(params, 2, mode="socket")
+    single.start()
+    group.start()
+    try:
+        sc = single.client()
+        gc = group.client()
+        for seed in range(4):
+            delta = _delta(seed)
+            sc.update_parameters(delta)
+            gc.update_parameters(delta)
+        a, b = sc.get_parameters(), gc.get_parameters()
+        assert _tree_digest(a) == _tree_digest(b)
+        # And the group's driver-side merge agrees with the wire path.
+        assert _tree_digest(group.get_parameters()) == _tree_digest(b)
+        sc.close()
+        gc.close()
+    finally:
+        single.stop()
+        group.stop()
+
+
+def test_group_client_per_shard_not_modified_cache():
+    hit_counter = obs.default_registry().counter("ps_cache_hit_total")
+    group = ShardGroup(_params(), 2, mode="socket")
+    group.start()
+    try:
+        client = group.client()
+        first = client.get_parameters()
+        before = hit_counter.value
+        second = client.get_parameters()  # unchanged: K not-modified frames
+        assert hit_counter.value == before + 2
+        assert _tree_digest(first) == _tree_digest(second)
+        client.update_parameters(_delta(0))  # bumps every shard's version
+        client.get_parameters()  # full bodies again
+        assert hit_counter.value == before + 2
+        client.close()
+    finally:
+        group.stop()
+
+
+def test_group_roles_and_snapshot():
+    group = ShardGroup(_params(), 2, mode="socket")
+    group.start()
+    try:
+        assert [group.primary(i).role for i in range(2)] == \
+            ["ps/shard0", "ps/shard1"]
+        snap = group.snapshot()
+        assert snap["plan"]["k"] == 2
+        assert snap["directory"]["digest"] == group.plan.digest
+        assert len(snap["directory"]["addresses"]) == 2
+    finally:
+        group.stop()
+
+
+# --------------------------------------------------------------------------
+# Handshake: digest pinning + fencing
+# --------------------------------------------------------------------------
+
+
+def test_client_rejects_stale_plan_digest():
+    group = ShardGroup(_params(), 2, mode="socket")
+    stale = ShardPlan.build(_params(seed=1), 2)  # different tree, same shape
+    other = ShardPlan.build({"only": np.zeros((4, 2), np.float32)}, 1)
+    assert stale.digest == group.plan.digest  # digest is metadata, not values
+    with pytest.raises(ShardMapMismatch):
+        ShardedParameterClient("socket", group.directory, other)
+
+
+def test_client_rejects_server_without_shard_map():
+    """Pointing the directory at a plain (unsharded) PS is a typed
+    error at handshake, not silent wrong-shaped traffic."""
+    plan = ShardPlan.build(_params(), 1)
+    server = SocketServer(_params(), lock=True, port=0)
+    server.start()
+    try:
+        directory = GroupDirectory(plan.digest, 1)
+        directory.publish(0, f"127.0.0.1:{server.port}", server.boot)
+        client = ShardedParameterClient("socket", directory, plan)
+        with pytest.raises(ShardMapMismatch):
+            client.get_parameters()
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_client_rejects_fenced_boot():
+    group = ShardGroup(_params(), 2, mode="socket")
+    group.start()
+    try:
+        group.directory.fence(group.primary(1).boot)
+        client = group.client()
+        with pytest.raises(FencedPrimaryError):
+            client.get_parameters()
+        client.close()
+    finally:
+        group.stop()
+
+
+# --------------------------------------------------------------------------
+# Promotion lifecycle
+# --------------------------------------------------------------------------
+
+
+def test_promotion_lifecycle_on_fake_clock(tmp_path):
+    """check()-driven failover: kill shard 0's primary, advance the
+    detector clock past dead_after, and verify the spare serves the
+    exact acked state under a fresh, unfenced boot id."""
+    clock = FakeClock()
+    group = ShardGroup(_params(), 2, mode="socket", standby=1,
+                       wal_root=str(tmp_path), suspect_after=5.0,
+                       clock=clock)
+    group.start()
+    client = group.client()
+    try:
+        for seed in range(3):
+            client.update_parameters(_delta(seed))
+        expected = client.get_parameters()
+        # The spare tails the primary's WAL to the acked version.
+        assert _wait_for(lambda: group.streamer_of(0).lag() == 0)
+        assert group.streamer_of(0).applied_version == 3
+        snap = group.snapshot()
+        assert all(row["warm"] for row in snap["standbys"])
+
+        old_boot = group.primary(0).boot
+        group.kill_primary(0)
+        gen_before = group.directory.generation
+        assert group.check() == []  # dead but not yet swept: still SUSPECT
+        clock.advance(11.0)  # past dead_after (2x suspect_after)
+        assert group.check() == [0]
+
+        assert group.directory.is_fenced(old_boot)
+        assert group.standby_of(0) is None  # the spare is spent
+        assert group.directory.generation > gen_before
+        record = group.promotions[-1]
+        assert record["shard"] == 0 and record["old_boot"] == old_boot
+        assert record["caught_up_version"] == 3
+        assert record["promote_s"] >= 0.0
+        # Zero acked-update loss: the re-resolved client reads the same
+        # tree the dead primary acked.
+        after = client.get_parameters()
+        assert _tree_digest(after) == _tree_digest(expected)
+        # Second failure of the same shard has no spare left.
+        group.kill_primary(0)
+        clock.advance(11.0)
+        assert group.check() == []
+    finally:
+        client.close()
+        group.stop()
+
+
+def test_live_kill_primary_promotes_standby(tmp_path):
+    """Integration: real clock, monitor thread, real sockets. Kill a
+    primary mid-run and the client's next pulls recover the acked state
+    through the promoted standby."""
+    group = ShardGroup(_params(), 2, mode="socket", standby=1,
+                       wal_root=str(tmp_path), suspect_after=0.3)
+    group.start()
+    client = group.client()
+    try:
+        for seed in range(3):
+            client.update_parameters(_delta(seed))
+        expected = client.get_parameters()
+        assert _wait_for(lambda: group.streamer_of(1).lag() == 0)
+        group.start_monitor(interval=0.05)
+        group.kill_primary(1)
+        assert _wait_for(lambda: group.promotions, timeout=15.0), \
+            "monitor never promoted the standby"
+        assert group.promotions[0]["shard"] == 1
+        after = client.get_parameters()
+        assert _tree_digest(after) == _tree_digest(expected)
+    finally:
+        client.close()
+        group.stop()
