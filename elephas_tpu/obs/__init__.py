@@ -82,6 +82,21 @@ from elephas_tpu.obs.fleet import (  # noqa: F401
     ProcessRegistry,
     parse_prometheus_text,
 )
+from elephas_tpu.obs.load import (  # noqa: F401
+    LoadScore,
+    LoadSnapshot,
+    LoadTracker,
+    instant_load,
+)
+from elephas_tpu.obs.slo import (  # noqa: F401
+    GoodputLedger,
+    SLOObjective,
+    default_objectives,
+)
+from elephas_tpu.obs.canary import (  # noqa: F401
+    CanaryDriver,
+    PSCanary,
+)
 
 _tracer: Tracer = NULL_TRACER
 _registry = MetricsRegistry()

@@ -44,6 +44,9 @@ RULE_NAMES = (
     "push_retry_rate",
     "serving_itl_p99_high",
     "shard_failover_rate",
+    "goodput_burn_high",
+    "goodput_burn_critical",
+    "canary_probe_failures",
 )
 
 _PREDICATES = (">", "<")
@@ -129,6 +132,22 @@ def default_rules() -> List[AlertRule]:
         AlertRule("shard_failover_rate", "ps_shard_failover_total",
                   ">", 1 / 300.0, kind="shard_failover", mode="rate",
                   window_s=600.0, severity="error"),
+        # Multi-window SLO burn (obs/slo.py mirrors
+        # serving_goodput_burn{objective=} = min(fast, slow bad
+        # fraction) / budget — both windows must be burning for the
+        # gauge to rise, so these are the classic fast+slow AND-gate
+        # as plain value rules, latch-until-clean like every rule).
+        # Warn at budget parity, page at the 6x fast burn.
+        AlertRule("goodput_burn_high", "serving_goodput_burn",
+                  ">", 1.0, kind="goodput_burn", severity="warn"),
+        AlertRule("goodput_burn_critical", "serving_goodput_burn",
+                  ">", 6.0, kind="goodput_burn", severity="error"),
+        # Blackbox canary probes failing at any sustained rate: users
+        # (or workers) cannot get through regardless of what the
+        # whitebox metrics claim.
+        AlertRule("canary_probe_failures", "serving_canary_fail_total",
+                  ">", 0.0, kind="canary_fail", mode="rate",
+                  window_s=60.0, severity="error"),
     ]
 
 

@@ -465,9 +465,19 @@ class FleetAggregator:
             else:
                 entry._set_status("stale", now)
             return False
+        scrape = {"metrics": metrics, "workers": workers, "alerts": alerts}
+        # Saturation/goodput routes are OPTIONAL per process: a roster
+        # can mix newer engines with older procs (or fakes) that don't
+        # serve them, and their absence must not fail the whole poll —
+        # each is fetched in its own tolerant attempt.
+        for route in ("/load", "/slo"):
+            try:
+                scrape[route[1:]] = json.loads(
+                    self.fetch(f"{entry.url}{route}", self.timeout))
+            except Exception:
+                pass
         entry.meta = meta
-        entry.scrape = {"metrics": metrics, "workers": workers,
-                        "alerts": alerts}
+        entry.scrape = scrape
         entry.last_ok = now
         entry.last_error = None
         entry._set_status("alive", now)
@@ -507,6 +517,13 @@ class FleetAggregator:
                        for e in entries if "workers" in e.scrape}
         per_alerts = {e.name: e.scrape["alerts"]
                       for e in entries if "alerts" in e.scrape}
+        # Per-proc saturation/goodput views: like gauges, these are NOT
+        # summed (a fleet-total load score is a lie) — consumers key by
+        # process name and read the status alongside.
+        per_load = {e.name: e.scrape["load"]
+                    for e in entries if "load" in e.scrape}
+        per_slo = {e.name: e.scrape["slo"]
+                   for e in entries if "slo" in e.scrape}
         status_counts: Dict[str, int] = {}
         for e in entries:
             status_counts[e.status] = status_counts.get(e.status, 0) + 1
@@ -519,4 +536,6 @@ class FleetAggregator:
             "metrics": merge_metrics(per_metrics),
             "workers": _merge_workers(per_workers),
             "alerts": _merge_alerts(per_alerts),
+            "load": per_load,
+            "slo": per_slo,
         }

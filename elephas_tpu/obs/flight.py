@@ -61,6 +61,9 @@ KINDS = (
     "shard_failover",
     "standby_promoted",
     "shard_map_mismatch",
+    # goodput / canary plane (obs/slo.py, obs/canary.py)
+    "goodput_burn",
+    "canary_fail",
 )
 
 

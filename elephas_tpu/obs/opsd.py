@@ -80,6 +80,9 @@ ROUTES = (
     "/profile",
     "/fleet",
     "/shards",
+    "/load",
+    "/slo",
+    "/canary",
 )
 
 
@@ -120,6 +123,15 @@ class OpsServer:
     shards_fn: the ``/shards`` payload (a ``ShardGroup.snapshot`` —
         plan digest, directory generation, standby lag, promotions);
         empty doc when unset.
+    load_fn: the ``/load`` payload (a ``LoadTracker.snapshot`` — EWMA
+        saturation score plus raw signal anatomy); null score when
+        unset.
+    slo_fn: the ``/slo`` payload (a ``GoodputLedger.snapshot`` —
+        objectives, windowed goodput ratios, burn rates); empty
+        objective pack when unset.
+    canary_fn: the ``/canary`` payload (a ``CanaryDriver.snapshot`` /
+        ``PSCanary.snapshot`` — blackbox probe SLIs); zero probes when
+        unset.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
@@ -132,7 +144,10 @@ class OpsServer:
                  alerts_fn: Optional[Callable[[], Dict]] = None,
                  history=None, profiler=None,
                  fleet_fn: Optional[Callable[[], Dict]] = None,
-                 shards_fn: Optional[Callable[[], Dict]] = None):
+                 shards_fn: Optional[Callable[[], Dict]] = None,
+                 load_fn: Optional[Callable[[], Dict]] = None,
+                 slo_fn: Optional[Callable[[], Dict]] = None,
+                 canary_fn: Optional[Callable[[], Dict]] = None):
         self._requested_port = port
         self.host = host if host is not None else _default_bind_host()
         self._registry = registry
@@ -149,6 +164,9 @@ class OpsServer:
         self._profiler = profiler
         self._fleet_fn = fleet_fn
         self._shards_fn = shards_fn
+        self._load_fn = load_fn
+        self._slo_fn = slo_fn
+        self._canary_fn = canary_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_wall = None
@@ -169,6 +187,9 @@ class OpsServer:
         self._add_route("/profile", self._h_profile)
         self._add_route("/fleet", self._h_fleet)
         self._add_route("/shards", self._h_shards)
+        self._add_route("/load", self._h_load)
+        self._add_route("/slo", self._h_slo)
+        self._add_route("/canary", self._h_canary)
 
     def _add_route(self, path: str, handler: Callable) -> None:
         self._routes[path] = handler
@@ -299,6 +320,24 @@ class OpsServer:
             return 200, self._shards_fn()
         return 200, {"plan": None, "directory": None, "standbys": [],
                      "promotions": []}
+
+    def _h_load(self, query):
+        if self._load_fn is not None:
+            return 200, self._load_fn()
+        return 200, {"score": None, "raw": None, "observations": 0,
+                     "signals": None}
+
+    def _h_slo(self, query):
+        if self._slo_fn is not None:
+            return 200, self._slo_fn()
+        return 200, {"objectives": [], "evaluated": 0, "goodput": {},
+                     "burn": {}, "goodput_ratio": None}
+
+    def _h_canary(self, query):
+        if self._canary_fn is not None:
+            return 200, self._canary_fn()
+        return 200, {"surface": None, "probes": 0, "failures": 0,
+                     "failure_ratio": None, "last": None}
 
     def start(self) -> "OpsServer":
         if self._httpd is not None:

@@ -86,6 +86,15 @@ def _shard_failover_counter():
     )
 
 
+def _standby_lag_gauge():
+    return obs.default_registry().gauge(
+        "ps_standby_lag_snapshots",
+        "durable WAL snapshot versions a shard's hot standby has not "
+        "yet applied",
+        labelnames=("shard",),
+    )
+
+
 # -- shard plan ---------------------------------------------------------------
 
 
@@ -434,6 +443,17 @@ class ShardedParameterClient(BaseParameterClient):
                 self._clients[shard] = client
             return client
 
+    def shard_client(self, shard: int):
+        """The dialed wire sub-client for ONE shard — the blackbox
+        canary's per-shard probe surface (``obs.canary.PSCanary`` times
+        a write-read round trip against each shard independently, so a
+        single dead primary is attributable). Shares the pool's cache,
+        verification, and generation-bump re-dial."""
+        if not 0 <= shard < self._plan.k:
+            raise ValueError(
+                f"shard {shard} outside plan of {self._plan.k}")
+        return self._client(shard)
+
     def _fanout(self, fn, shards: Optional[List[int]] = None) -> List[Any]:
         """Run ``fn(shard, client)`` for every shard concurrently; the
         first failure propagates (after every future settles, so no
@@ -781,6 +801,9 @@ class ShardGroup(BaseParameterServer):
             shard = int(str(worker_id)[len("shard"):])
             if self.promote(shard):
                 promoted.append(shard)
+        # Every monitor pass refreshes the standby-lag gauge, so lag is
+        # visible even on processes nobody scrapes through /shards.
+        self._publish_standby_lag()
         return promoted
 
     def promote(self, shard: int) -> bool:
@@ -863,19 +886,32 @@ class ShardGroup(BaseParameterServer):
         self._monitor.join(timeout=5)
         self._monitor = None
 
+    def _publish_standby_lag(self) -> List[Dict[str, Any]]:
+        """Per-standby WAL lag, mirrored into the registry gauge
+        ``ps_standby_lag_snapshots{shard=}`` (``ps_`` prefix → sampled
+        into history rings wherever a sampler runs). A promoted or
+        never-staffed shard has no streamer and reports ``None`` —
+        the gauge pins 0 there rather than holding a stale lag."""
+        out = []
+        gauge = _standby_lag_gauge()
+        for i, spare in enumerate(self._standbys):
+            streamer = self._streamers[i]
+            lag = streamer.lag() if streamer else None
+            gauge.labels(shard=str(i)).set(float(lag or 0))
+            out.append({
+                "shard": i,
+                "warm": spare is not None,
+                "applied_version": (streamer.applied_version
+                                    if streamer else None),
+                "lag": lag,
+            })
+        return out
+
     def snapshot(self) -> Dict[str, Any]:
         """Introspection doc for the opsd ``/shards`` route."""
         return {
             "plan": self.plan.describe(),
             "directory": self.directory.snapshot(),
-            "standbys": [
-                {"shard": i,
-                 "warm": spare is not None,
-                 "applied_version": (self._streamers[i].applied_version
-                                     if self._streamers[i] else None),
-                 "lag": (self._streamers[i].lag()
-                         if self._streamers[i] else None)}
-                for i, spare in enumerate(self._standbys)
-            ],
+            "standbys": self._publish_standby_lag(),
             "promotions": list(self.promotions),
         }

@@ -156,6 +156,12 @@ class InferenceEngine:
         self.pool = KVCachePool(self.decode_module, max_slots, max_len)
         self.queue = RequestQueue(max_depth=queue_depth)
         self.metrics = ServingMetrics(sink=sink, clock=clock)
+        # Saturation + goodput plane, both on the engine's clock: the
+        # scheduler feeds the load tracker every step; finished results
+        # are evaluated into the goodput ledger as they publish (canary
+        # probes excluded — see _publish).
+        self.load = obs.LoadTracker(clock=clock)
+        self.slo = obs.GoodputLedger(clock=clock)
         self.scheduler = ContinuousBatchingScheduler(
             self.pool,
             self.queue,
@@ -167,6 +173,7 @@ class InferenceEngine:
             clock=clock,
             pipeline=pipeline,
             tracer=self.tracer,
+            load=self.load,
         )
 
         self._prefill_traces = 0
@@ -179,6 +186,12 @@ class InferenceEngine:
         self._cond = threading.Condition()
         self._step_lock = threading.Lock()
         self.ops = None  # OpsServer, mounted on demand
+        # Canary exclusion: req_ids submitted with canary=True (guarded
+        # by _cond). Their results still publish normally — the driver
+        # retrieves them via result() — but never reach the goodput
+        # ledger, so real-traffic SLO accounting is canary-blind.
+        self._canary_ids: set = set()
+        self.canary = None  # CanaryDriver, attached on demand
 
     def _make_jits(self, in_shardings=None, out_shardings=None):
         """(Re)build the two compiled entry points. With shardings the
@@ -356,9 +369,16 @@ class InferenceEngine:
         max_new_tokens: int = 32,
         stop_token: Optional[int] = "default",
         timeout_s: Optional[float] = None,
+        canary: bool = False,
     ) -> int:
         """Enqueue a request; returns its id. Raises ``QueueFull`` (with
-        ``.retry_after``) when admission control rejects it."""
+        ``.retry_after``) when admission control rejects it.
+
+        ``canary=True`` tags the request as a blackbox probe: it rides
+        the identical admission/prefill/decode path but its finished
+        result is excluded from the goodput ledger (the tag must land
+        before the queue submit — a serve thread can finish the probe
+        before this method returns)."""
         prompt = [int(t) for t in prompt]  # host-ok: caller-supplied ints
         if not 1 <= len(prompt) <= self.max_prompt_len:
             raise ValueError(
@@ -377,9 +397,15 @@ class InferenceEngine:
             submitted_at=now,
             deadline=None if timeout_s is None else now + timeout_s,
         )
+        if canary:
+            with self._cond:
+                self._canary_ids.add(req.req_id)
         try:
             self.queue.submit(req)
         except QueueFull as err:
+            if canary:
+                with self._cond:
+                    self._canary_ids.discard(req.req_id)
             self.metrics.record_reject()
             obs.default_flight_recorder().note(
                 "backpressure_reject", "warn", req_id=req.req_id,
@@ -407,15 +433,26 @@ class InferenceEngine:
                 time.sleep(max(delay, err.retry_after))
         raise AssertionError("unreachable")
 
+    def _publish(self, finished: List[GenerationResult]) -> None:
+        """Make finished results claimable and account goodput — canary
+        probes publish (the driver claims them via ``result()``) but are
+        never evaluated into the real-traffic SLO ledger."""
+        if not finished:
+            return
+        with self._cond:
+            real = [r for r in finished if r.req_id not in self._canary_ids]
+            for r in finished:
+                self._results[r.req_id] = r
+                self._canary_ids.discard(r.req_id)
+            self._cond.notify_all()
+        for r in real:
+            self.slo.record(r)
+
     def step(self) -> List[GenerationResult]:
         """One scheduler iteration; publishes finished results."""
         with self._step_lock:
             finished = self.scheduler.step()
-        if finished:
-            with self._cond:
-                for r in finished:
-                    self._results[r.req_id] = r
-                self._cond.notify_all()
+        self._publish(finished)
         return finished
 
     def result(
@@ -435,11 +472,7 @@ class InferenceEngine:
                     finished = self.scheduler.step()
                 finally:
                     self._step_lock.release()
-                if finished:
-                    with self._cond:
-                        for r in finished:
-                            self._results[r.req_id] = r
-                        self._cond.notify_all()
+                self._publish(finished)
                 continue
             with self._cond:
                 if req_id in self._results:
@@ -471,6 +504,16 @@ class InferenceEngine:
 
     # -- observability -----------------------------------------------------
 
+    def attach_canary(self, driver) -> None:
+        """Register the blackbox probe driver serving ``/canary``."""
+        self.canary = driver
+
+    def _canary_doc(self) -> dict:
+        if self.canary is not None:
+            return self.canary.snapshot()
+        return {"surface": None, "probes": 0, "failures": 0,
+                "failure_ratio": None, "last": None}
+
     def stats(self) -> dict:
         return {
             **self.metrics.summary(),
@@ -486,8 +529,11 @@ class InferenceEngine:
         engine: ``/metrics``, ``/healthz`` (+ queue/pool summary),
         ``/trace``, ``/vars``, ``/flight``, ``/alerts`` (stock SLO rule
         pack — its serving ITL rule reads the registry mirror
-        ``ServingMetrics`` feeds). Loopback-bound by default; port 0
-        picks a free one (read ``engine.ops.port``). Idempotent.
+        ``ServingMetrics`` feeds), plus the saturation/goodput plane:
+        ``/load`` (EWMA load score), ``/slo`` (windowed goodput +
+        burn), ``/canary`` (blackbox probe SLIs when a driver is
+        attached). Loopback-bound by default; port 0 picks a free one
+        (read ``engine.ops.port``). Idempotent.
         """
         if self.ops is not None:
             return self.ops
@@ -514,6 +560,9 @@ class InferenceEngine:
                 "pool_active": self.pool.active_count,
                 "pool_free": self.pool.free_count,
             },
+            load_fn=self.load.snapshot,
+            slo_fn=self.slo.snapshot,
+            canary_fn=self._canary_doc,
         ).start()
         return self.ops
 

@@ -176,6 +176,7 @@ class ContinuousBatchingScheduler:
         clock=time.monotonic,
         pipeline: bool = True,
         tracer=None,
+        load=None,
     ):
         self.pool = pool
         self.queue = queue
@@ -186,6 +187,11 @@ class ContinuousBatchingScheduler:
         self.metrics = metrics
         self.clock = clock
         self.pipeline = pipeline
+        # Saturation plane (obs.LoadTracker, engine-owned): fed once per
+        # step with the queue/slot/KV signals already in hand here, so
+        # the /load route and a future admission router see a score
+        # computed on this scheduler's own clock.
+        self.load = load
         # Span recording: retroactive `record()` calls with THIS clock's
         # timestamps — the tracer must share the clock domain (the
         # engine passes its own). A disabled tracer makes every call a
@@ -470,6 +476,21 @@ class ContinuousBatchingScheduler:
             self.metrics.record_step(
                 queue_depth=len(self.queue), active=len(self._active),
                 tokens=emitted, step_seconds=t1 - t0,
+            )
+        if self.load is not None:
+            self.load.observe(
+                queue_depth=len(self.queue),
+                queue_limit=self.queue.max_depth,
+                active=len(self._active),
+                max_slots=self.pool.max_slots,
+                kv_free_frac=self.pool.free_count / self.pool.max_slots,
+                admitted_total=(self.metrics.requests_submitted
+                                if self.metrics else 0),
+                rejected_total=(self.metrics.requests_rejected
+                                if self.metrics else 0),
+                tokens_total=(self.metrics.tokens_out
+                              if self.metrics else 0),
+                now=t1,
             )
         return self._results[before:]
 

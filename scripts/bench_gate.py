@@ -61,6 +61,19 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             # ceiling: tracing must stay under 2% regardless of what
             # the committed baseline happened to measure.
             ("overhead_pct", "limit", 2.0),
+            # Blackbox canary probes ride the real submit path, so
+            # their cost is gated with the same discipline: real-
+            # traffic throughput with canaries on must stay within 2%
+            # of canaries off (measured best-of-rounds, lm_bench
+            # --slo).
+            ("canary_overhead_pct", "limit", 2.0),
+            # Goodput floor on the --slo row: the worst-objective SLO
+            # attainment ratio over the bench workload. An absolute
+            # floor — at bench scale (unloaded engine, generous
+            # thresholds) every request should meet every objective;
+            # dipping under 0.9 means latency promises broke or the
+            # ledger started counting canaries.
+            ("goodput_ratio", "floor", 0.90),
         ],
     ),
     "ps": (
@@ -112,6 +125,10 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             # Zero acked-update loss: the post-promotion pull must equal
             # the last tree the dead primary acked, replay-stably.
             ("acked_state_recovered", "equal", 0.0),
+            # Blackbox visibility of the kill: the PS canary probing
+            # through the real sharded-client path must SEE the outage
+            # (failed probes on the killed shard) and see it end.
+            ("canary_saw_outage", "equal", 0.0),
         ],
     ),
 }

@@ -378,6 +378,60 @@ def alert_ladder(seed: int):
     return [a["kind"] for a in engine.fired]
 
 
+def goodput_burn_ladder(seed: int):
+    """Deterministic multi-window burn-rate replay: drive a PRIVATE
+    GoodputLedger/registry/alert-engine stack through good traffic, a
+    bad-TTFT burst, a recovery, and a second burst — all on pinned
+    timestamps — and return the ordered rule names that fired. Same
+    seed → byte-identical sequence; ``test_chaos.py`` pins it and the
+    ``--health`` row commits it.
+
+    The shape under test: the ``serving_goodput_burn`` gauge is
+    ``min(fast, slow bad fraction) / budget``, so the burst must poison
+    BOTH windows to fire (fast+slow AND-gate), the warn rule
+    (``goodput_burn_high``, burn > 1) precedes the page rule
+    (``goodput_burn_critical``, burn > 6) in pack order, both latch
+    until the fast window runs clean, and the second burst re-fires
+    them — latch-until-clean, not fire-once."""
+    from types import SimpleNamespace
+
+    from elephas_tpu import obs
+
+    reg = obs.MetricsRegistry()
+    engine = obs.AlertEngine(registry=reg, flight=obs.FlightRecorder(),
+                             clock=lambda: 0.0)
+    ledger = obs.GoodputLedger(clock=lambda: 0.0, registry=reg)
+    rng = np.random.default_rng(seed)
+
+    def finish(t, ttft):
+        ledger.record(SimpleNamespace(
+            status="completed", ttft_s=ttft,
+            itl_s_avg=float(rng.uniform(0.001, 0.01))), now=t)
+
+    # t=0..40: healthy traffic — every objective met, burn 0.
+    for t in np.linspace(0.0, 40.0, 40):
+        finish(float(t), ttft=float(rng.uniform(0.01, 0.1)))
+    engine.evaluate(now=41.0)
+    # t=45..55: TTFT burst (5 s >> the 2.5 s objective). 30 bad against
+    # 40 good poisons the fast window (~43% bad) AND the slow window
+    # (~43% too — everything is inside 600 s), so burn >> 6: the warn
+    # fires, then the page, in pack order.
+    for t in np.linspace(45.0, 55.0, 30):
+        finish(float(t), ttft=5.0)
+    engine.evaluate(now=56.0)
+    # t=70..130: recovery traffic. By t=130 the fast window (last 60 s)
+    # holds only good finishes → fast bad fraction 0 → burn 0: both
+    # rules run clean and re-arm.
+    for t in np.linspace(70.0, 130.0, 60):
+        finish(float(t), ttft=float(rng.uniform(0.01, 0.1)))
+    engine.evaluate(now=131.0)
+    # t=135..145: second burst — the re-armed ladder fires again.
+    for t in np.linspace(135.0, 145.0, 30):
+        finish(float(t), ttft=5.0)
+    engine.evaluate(now=146.0)
+    return [a["rule"] for a in engine.fired]
+
+
 def staleness_probe(seed: int, steps: int = 24):
     """Deterministic wire-level staleness ladder against a real socket
     PS: per step, a probe client pulls (pinning the version it "trained
@@ -473,6 +527,7 @@ def scenario_health(x, y, epochs, seed: int = 11):
         unstamped_updates=led["unstamped_updates"],
         workers=workers,
         alert_seq=alert_ladder(seed),
+        burn_alert_seq=goodput_burn_ladder(seed),
     )
 
 
@@ -486,6 +541,7 @@ def scenario_shard_kill(seed: int = 11, k: int = 2, updates: int = 6):
 
     import jax
 
+    from elephas_tpu.obs.canary import PSCanary
     from elephas_tpu.parameter.group import ShardGroup
 
     def digest(tree):
@@ -523,9 +579,35 @@ def scenario_shard_kill(seed: int = 11, k: int = 2, updates: int = 6):
                       for i in range(k)) and time.perf_counter() < deadline:
                 time.sleep(0.01)
 
+            # Blackbox canary on its OWN client — the probe must see the
+            # outage through the same re-resolve/retry path a real
+            # worker uses, without sharing the measured client's
+            # connection state.
+            probe_client = group.client()
+            probe_client.worker_id = "canary"
+            canary = PSCanary(probe_client, group=group)
+            pre = canary.probe()
+            standby_lag_prekill = pre["standby_lag"]
+
             group.start_monitor(interval=0.05)
             t0 = time.perf_counter()
             group.kill_primary(0)
+            # The canary probes from its own thread so the MTTR loop
+            # below stays exactly what it measures: the canary's failed
+            # round-trips each burn the client retry budget, and running
+            # them inline would bill that to the failover.
+            probe_log = []  # (seconds since kill, probe ok)
+            stop_probing = threading.Event()
+
+            def probe_loop():
+                while not stop_probing.is_set():
+                    p = canary.probe()
+                    probe_log.append((time.perf_counter() - t0,
+                                      bool(p["ok"])))
+                    stop_probing.wait(0.05)
+
+            prober = threading.Thread(target=probe_loop, daemon=True)
+            prober.start()
             after = None
             while after is None and time.perf_counter() - t0 < 60.0:
                 try:
@@ -533,8 +615,24 @@ def scenario_shard_kill(seed: int = 11, k: int = 2, updates: int = 6):
                 except Exception:
                     time.sleep(0.02)
             mttr = time.perf_counter() - t0
+            stop_probing.set()
+            prober.join(timeout=30.0)
+            # One probe after recovery so the log always ends healthy
+            # when the failover worked.
+            p = canary.probe()
+            probe_log.append((time.perf_counter() - t0, bool(p["ok"])))
+            # Canary-visible outage window: first failed probe to the
+            # first success after it.
+            first_fail = next((t for t, ok in probe_log if not ok), None)
+            outage_s = None
+            if first_fail is not None:
+                outage_end = next((t for t, ok in probe_log
+                                   if t > first_fail and ok), None)
+                if outage_end is not None:
+                    outage_s = outage_end - first_fail
+            csnap = canary.snapshot()
             promo = group.promotions[-1] if group.promotions else {}
-            return {
+            row = {
                 "scenario": "shard_kill", "shards": k, "standby": 1,
                 "updates_acked": updates,
                 "shard_failover_mttr_s": round(mttr, 3),
@@ -545,8 +643,20 @@ def scenario_shard_kill(seed: int = 11, k: int = 2, updates: int = 6):
                 "acked_state_recovered": (after is not None
                                           and digest(after) == acked_digest),
                 "final_digest": acked_digest,
+                "canary_probes": csnap["probes"],
+                "canary_failed_probes": csnap["failures"],
+                "canary_outage_s": (None if outage_s is None
+                                    else round(outage_s, 3)),
+                # bench_gate pins this to True ("equal" check): the
+                # blackbox probe must have SEEN the kill and seen it
+                # end.
+                "canary_saw_outage": (first_fail is not None
+                                      and outage_s is not None),
+                "standby_lag_prekill": standby_lag_prekill,
                 "seed": seed,
             }
+            probe_client.close()
+            return row
         finally:
             client.close()
             group.stop()

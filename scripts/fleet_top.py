@@ -4,7 +4,9 @@
 The terminal counterpart of the ``/fleet`` route: point it at every
 process's opsd URL and get the merged picture — who is alive/stale/dead
 (with boot ids, so a warm restart is visible as the same slot coming
-back different), the fleet-summed counters, pooled histogram
+back different), per-process LOAD (EWMA saturation score from ``/load``)
+and GOODPUT (worst-objective SLO attainment from ``/slo``; both render
+``-`` for stale/dead procs), the fleet-summed counters, pooled histogram
 percentiles, cluster worker ledger, and active alerts.
 
 Usage:
@@ -34,6 +36,28 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from elephas_tpu.obs.fleet import FleetAggregator  # noqa: E402
 
 
+def _load_cell(snap: dict, name: str, status: str) -> str:
+    """LOAD column: the EWMA saturation score from the proc's /load
+    snapshot. A stale/dead process renders '-' — a router dispatching
+    on a score that stopped updating is worse than knowing nothing."""
+    if status != "alive":
+        return "-"
+    doc = (snap.get("load") or {}).get(name) or {}
+    score = doc.get("score")
+    return f"{score:.2f}" if score is not None else "-"
+
+
+def _goodput_cell(snap: dict, name: str, status: str) -> str:
+    """GOODPUT column: the proc's worst-objective goodput ratio from
+    its /slo snapshot, as a percentage; '-' when stale/dead or before
+    any finished traffic."""
+    if status != "alive":
+        return "-"
+    doc = (snap.get("slo") or {}).get(name) or {}
+    ratio = doc.get("goodput_ratio")
+    return f"{100.0 * ratio:.1f}%" if ratio is not None else "-"
+
+
 def render(snap: dict) -> str:
     """The merged fleet snapshot as a fixed-width text board."""
     lines: List[str] = []
@@ -45,7 +69,8 @@ def render(snap: dict) -> str:
     # ROLE is 12 wide: shard-group members report differentiated roles
     # ("ps/shard0", "ps/standby"), not just the flat "ps"/"worker".
     lines.append(f"{'NAME':<10} {'ROLE':<12} {'STATUS':<7} {'BOOT':<14} "
-                 f"{'WORKER':<8} {'LAST OK':>8}  URL")
+                 f"{'WORKER':<8} {'LAST OK':>8} {'LOAD':>5} {'GOODPUT':>8}"
+                 f"  URL")
     for name, p in sorted(snap["processes"].items()):
         meta = p.get("meta") or {}
         ago = p.get("last_ok_s_ago")
@@ -53,7 +78,9 @@ def render(snap: dict) -> str:
             f"{name:<10} {str(meta.get('role', '?')):<12} "
             f"{p['status']:<7} {str(meta.get('boot', ''))[:14]:<14} "
             f"{str(meta.get('worker_id') or '-'):<8} "
-            f"{('%.1fs' % ago) if ago is not None else '-':>8}  {p['url']}"
+            f"{('%.1fs' % ago) if ago is not None else '-':>8} "
+            f"{_load_cell(snap, name, p['status']):>5} "
+            f"{_goodput_cell(snap, name, p['status']):>8}  {p['url']}"
         )
     metrics = snap["metrics"]
     if metrics["counters"]:

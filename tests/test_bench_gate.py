@@ -255,3 +255,57 @@ def test_shard_kill_rules_gate_mttr_and_acked_loss():
     by = _checks_by_metric(bad)
     assert by[("shard_kill", "shard_failover_mttr_s")]["threshold"] == \
         "must be <= 10.0"
+
+
+def test_canary_overhead_rule_is_absolute_ceiling():
+    """The --slo serve row's canary_overhead_pct rides the tracing
+    guardrail's discipline: an absolute 2% ceiling, baseline ignored —
+    a fresh run better than baseline still fails past the ceiling."""
+    base = [{"mode": "serving_slo", "pipeline": True,
+             "canary_overhead_pct": 4.0}]
+    over = bg.compare(base, [
+        {"mode": "serving_slo", "pipeline": True,
+         "canary_overhead_pct": 2.5}], "serve")
+    assert [c["ok"] for c in over] == [False]
+    assert over[0]["threshold"] == "must be <= 2.0"
+    under = bg.compare(base, [
+        {"mode": "serving_slo", "pipeline": True,
+         "canary_overhead_pct": 0.3}], "serve")
+    assert [c["ok"] for c in under] == [True]
+
+
+def test_goodput_floor_gates_slo_row_only():
+    """goodput_ratio is an absolute floor on the --slo row: at bench
+    scale every request should meet every objective, so dipping under
+    0.9 fails regardless of baseline. Rows without the metric (every
+    other serve mode) are untouched."""
+    base = [{"mode": "serving_slo", "pipeline": True, "goodput_ratio": 1.0},
+            {"mode": "serving", "pipeline": True, "tokens_per_sec": 50.0}]
+    good = bg.compare(base, [
+        {"mode": "serving_slo", "pipeline": True, "goodput_ratio": 0.95},
+        {"mode": "serving", "pipeline": True, "tokens_per_sec": 50.0}],
+        "serve")
+    assert all(c["ok"] for c in good)
+    bad = bg.compare(base, [
+        {"mode": "serving_slo", "pipeline": True, "goodput_ratio": 0.85},
+        {"mode": "serving", "pipeline": True, "tokens_per_sec": 50.0}],
+        "serve")
+    failed = [c for c in bad if not c["ok"]]
+    assert [(c["key"], c["metric"]) for c in failed] == [
+        ("serving_slo/True", "goodput_ratio")]
+    assert failed[0]["threshold"] == "must be >= 0.9"
+    by = _checks_by_metric(bg.compare(base, base, "serve"))
+    assert ("serving/True", "goodput_ratio") not in by
+
+
+def test_canary_outage_visibility_rule_is_exact():
+    """The --shards row's canary_saw_outage is exact: a run where the
+    blackbox PS probe never saw the kill (or never saw it end) fails —
+    whitebox MTTR alone doesn't prove outside visibility."""
+    base = [{"scenario": "shard_kill", "canary_saw_outage": True}]
+    assert all(c["ok"] for c in bg.compare(
+        base, [{"scenario": "shard_kill", "canary_saw_outage": True}],
+        "chaos"))
+    blind = bg.compare(base, [
+        {"scenario": "shard_kill", "canary_saw_outage": False}], "chaos")
+    assert [c["ok"] for c in blind] == [False]

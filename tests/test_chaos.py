@@ -240,6 +240,20 @@ def test_health_alert_sequence_is_replay_stable():
                        "worker_lagging", "slo_breach"]
 
 
+def test_goodput_burn_ladder_is_replay_stable():
+    """Satellite pin: the seeded multi-window burn-rate ladder fires the
+    same rules in the same order on every run — burst poisons BOTH
+    windows (warn then page, pack order), a clean fast window re-arms
+    the latch, and the second burst re-fires. The BENCH_CHAOS
+    ``--health`` row commits it as ``burn_alert_seq``."""
+    import scripts.chaos_bench as chaos_bench
+
+    runs = [chaos_bench.goodput_burn_ladder(seed=11) for _ in range(2)]
+    assert runs[0] == runs[1]
+    assert runs[0] == ["goodput_burn_high", "goodput_burn_critical",
+                       "goodput_burn_high", "goodput_burn_critical"]
+
+
 def test_health_staleness_probe_lag_is_exact(blobs_xy):
     """The wire staleness probe induces a known lag per push; the PS
     ledger must account for every version of it exactly."""

@@ -479,3 +479,58 @@ def test_fleet_route_serves_the_aggregators_snapshot():
     finally:
         host.stop()
         member.stop()
+
+
+# --------------------------------------------------------------------------
+# Load / goodput federation (/load, /slo are optional per process)
+# --------------------------------------------------------------------------
+
+
+def test_fleet_federates_load_and_slo_per_proc():
+    """/load and /slo are federated per process — never summed (a
+    fleet-total load score is a lie) — and a process that doesn't serve
+    the routes (an older build; the fake raises on unknown paths) still
+    polls alive: the saturation plane is optional, not a poll gate."""
+    bodies_a = _fake_bodies()
+    bodies_a["/load"] = json.dumps(
+        {"score": 0.25, "raw": 0.3, "observations": 5}).encode()
+    bodies_a["/slo"] = json.dumps(
+        {"evaluated": 10, "goodput_ratio": 0.98}).encode()
+    agg = FleetAggregator(clock=lambda: 0.0, fetch=_fake_fetch_factory({
+        "http://a": bodies_a,
+        "http://b": _fake_bodies(),  # pre-saturation-plane process
+    }))
+    agg.add("http://a", name="a")
+    agg.add("http://b", name="b")
+    tally = agg.poll(now=0.0)
+    assert tally["failed"] == 0
+    snap = agg.snapshot(now=0.0)
+    assert snap["processes"]["b"]["status"] == "alive"
+    assert snap["load"] == {"a": {"score": 0.25, "raw": 0.3,
+                                  "observations": 5}}
+    assert snap["slo"] == {"a": {"evaluated": 10, "goodput_ratio": 0.98}}
+
+
+def test_fleet_top_renders_load_and_goodput_columns():
+    """The board shows per-proc LOAD/GOODPUT for alive processes and
+    '-' for dead ones — a router must never dispatch on a score that
+    stopped updating."""
+    import scripts.fleet_top as fleet_top
+
+    bodies = _fake_bodies()
+    bodies["/load"] = json.dumps({"score": 0.4375}).encode()
+    bodies["/slo"] = json.dumps({"goodput_ratio": 0.987}).encode()
+    agg = FleetAggregator(dead_after=5.0, clock=lambda: 0.0,
+                          fetch=_fake_fetch_factory({"http://a": bodies}))
+    agg.add("http://a", name="a")
+    agg.add("http://gone", name="gone")  # never reachable
+    agg.poll(now=0.0)
+    agg.poll(now=10.0)  # "gone" promotes to dead
+    board = fleet_top.render(agg.snapshot(now=10.0))
+    row_a = next(ln for ln in board.splitlines() if ln.startswith("a "))
+    assert "0.44" in row_a and "98.7%" in row_a
+    row_gone = next(ln for ln in board.splitlines()
+                    if ln.startswith("gone "))
+    assert "dead" in row_gone
+    # Both new columns render '-' for the dead proc (no stale score).
+    assert row_gone.split()[-3:-1] == ["-", "-"]

@@ -1,0 +1,360 @@
+"""Saturation & goodput plane (``obs/load.py``, ``obs/slo.py``,
+``obs/canary.py``): load-score anatomy, SLO attainment accounting,
+multi-window burn math, and the canary-exclusion guarantee.
+
+Everything off the engine runs on injected clocks with pinned values —
+no sleeps, no timing races. The engine-level tests pin the wiring the
+ISSUE requires: the scheduler feeds the load tracker every step, every
+finished *real* request reaches the goodput ledger, and canary probes
+provably never do.
+"""
+
+import math
+from types import SimpleNamespace
+
+import jax.numpy as jnp
+import pytest
+
+from elephas_tpu import obs
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.models import get_model
+from elephas_tpu.obs.load import (
+    LoadScore,
+    LoadSnapshot,
+    LoadTracker,
+    instant_load,
+)
+from elephas_tpu.obs.slo import GoodputLedger, SLOObjective, default_objectives
+from elephas_tpu.serving import InferenceEngine
+
+VOCAB, SEQ = 97, 64
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return CompiledModel(
+        get_model(
+            "transformer_lm", vocab_size=VOCAB, d_model=32, num_heads=4,
+            num_layers=2, max_seq_len=SEQ,
+        ),
+        optimizer={"name": "adam", "learning_rate": 3e-3},
+        loss="sparse_categorical_crossentropy",
+        metrics=[],
+        input_shape=(SEQ,),
+        input_dtype=jnp.int32,
+        seed=0,
+    )
+
+
+def _engine(compiled, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("max_len", 24)
+    kw.setdefault("queue_depth", 8)
+    return InferenceEngine(compiled, **kw)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _snap(queue_depth=0, active=0, kv_free_frac=1.0, **kw):
+    return LoadSnapshot(t=0.0, queue_depth=queue_depth, queue_limit=8,
+                        active=active, max_slots=4,
+                        kv_free_frac=kv_free_frac, **kw)
+
+
+def _res(status="completed", ttft_s=0.05, itl_s_avg=0.01):
+    return SimpleNamespace(status=status, ttft_s=ttft_s, itl_s_avg=itl_s_avg)
+
+
+# -- instant_load: the raw blend ------------------------------------------
+
+
+def test_instant_load_pinned_values():
+    assert instant_load(_snap()) == 0.0
+    # queue half full (0.3*0.5) + half the slots (0.4*0.5) + half the
+    # KV pool gone (0.2*0.5), no shedding.
+    assert instant_load(_snap(queue_depth=4, active=2, kv_free_frac=0.5)) \
+        == pytest.approx(0.45)
+    # Saturated everything and actively shedding: exactly 1.0 — the
+    # weights sum to 1, no clamp involved.
+    assert instant_load(_snap(queue_depth=8, active=4, kv_free_frac=0.0,
+                              reject_rate=2.0)) == pytest.approx(1.0)
+
+
+def test_instant_load_monotone_under_rising_pressure():
+    """Rising queue depth (and each other pressure signal) can never
+    LOWER the score — the property a router dispatches on."""
+    by_queue = [instant_load(_snap(queue_depth=q)) for q in range(9)]
+    assert by_queue == sorted(by_queue) and by_queue[-1] > by_queue[0]
+    by_slots = [instant_load(_snap(active=a)) for a in range(5)]
+    assert by_slots == sorted(by_slots) and by_slots[-1] > by_slots[0]
+    by_kv = [instant_load(_snap(kv_free_frac=1.0 - f / 10.0))
+             for f in range(11)]
+    assert by_kv == sorted(by_kv) and by_kv[-1] > by_kv[0]
+
+
+# -- LoadScore: EWMA on the injected clock --------------------------------
+
+
+def test_load_score_ewma_pinned_on_injected_clock():
+    s = LoadScore(tau_s=5.0)
+    assert s.value is None
+    assert s.update(0.8, t=0.0) == 0.8  # first sample seeds the EWMA
+    expected = 0.8 + (1.0 - math.exp(-10.0 / 5.0)) * (0.2 - 0.8)
+    assert s.update(0.2, t=10.0) == pytest.approx(expected)
+    # dt == 0 degenerates to "no update", not a divide-by-zero.
+    assert s.update(1.0, t=10.0) == pytest.approx(expected)
+
+
+def test_load_score_replays_bit_identically():
+    def run():
+        s = LoadScore(tau_s=3.0)
+        return [s.update(raw, t=float(t))
+                for t, raw in enumerate([0.1, 0.9, 0.4, 0.4, 0.0, 1.0])]
+
+    assert run() == run()
+
+
+# -- LoadTracker: rates, snapshot document, registry mirror ----------------
+
+
+def test_load_tracker_differentiates_reject_counter_into_rate():
+    """Counter-valued inputs become trailing rates: 5 rejects over 10 s
+    reads as 0.5/s, which lifts an otherwise idle engine's raw score by
+    exactly half the reject weight."""
+    tr = LoadTracker(clock=lambda: 0.0)
+    tr.observe(queue_depth=0, queue_limit=8, active=0, max_slots=4,
+               kv_free_frac=1.0, rejected_total=0, now=0.0)
+    assert tr.snapshot()["raw"] == 0.0
+    tr.observe(queue_depth=0, queue_limit=8, active=0, max_slots=4,
+               kv_free_frac=1.0, rejected_total=5, now=10.0)
+    doc = tr.snapshot()
+    assert doc["signals"]["reject_rate_per_s"] == pytest.approx(0.5)
+    assert doc["raw"] == pytest.approx(0.05)
+    assert doc["observations"] == 2
+    # The smoothed score rode the registry mirror out as a gauge.
+    assert obs.default_registry().gauge("serving_load_score").value \
+        == pytest.approx(doc["score"])
+
+
+def test_load_tracker_replays_bit_identically():
+    def run():
+        tr = LoadTracker(clock=lambda: 0.0)
+        out = []
+        for t in range(0, 60, 5):
+            tr.observe(queue_depth=t % 8, queue_limit=8,
+                       active=min(t % 5, 4), max_slots=4,
+                       kv_free_frac=1.0 - (t % 10) / 10.0,
+                       rejected_total=t // 10, now=float(t))
+            out.append(tr.snapshot()["score"])
+        return out
+
+    assert run() == run()
+
+
+# -- SLOObjective: the promise semantics -----------------------------------
+
+
+def test_slo_objective_verdicts():
+    ttft = SLOObjective("ttft", "ttft", threshold_s=1.0)
+    itl = SLOObjective("itl", "itl", threshold_s=0.1)
+    deadline = SLOObjective("deadline", "deadline")
+    good = _res()
+    assert ttft.met(good) and itl.met(good) and deadline.met(good)
+    assert not ttft.met(_res(ttft_s=2.0))
+    assert itl.met(_res(itl_s_avg=None))  # one token: no gaps to violate
+    assert not ttft.met(_res(ttft_s=None))  # never answered != fast
+    # A timeout misses EVERY objective — "we never answered" is the
+    # worst latency, not a vacuous pass.
+    timed_out = _res(status="timeout")
+    assert not ttft.met(timed_out)
+    assert not itl.met(timed_out)
+    assert not deadline.met(timed_out)
+
+
+def test_slo_objective_validation():
+    with pytest.raises(ValueError):
+        SLOObjective("x", "throughput", threshold_s=1.0)  # unknown kind
+    with pytest.raises(ValueError):
+        SLOObjective("x", "ttft")  # latency objective needs a threshold
+    with pytest.raises(ValueError):
+        SLOObjective("x", "deadline", target=1.0)  # no error budget
+    assert [o.name for o in default_objectives()] == \
+        ["ttft", "itl_p99", "deadline"]
+
+
+# -- GoodputLedger: windowed ratios + multi-window burn --------------------
+
+
+def _ttft_ledger(**kw):
+    kw.setdefault("registry", obs.MetricsRegistry())
+    return GoodputLedger(
+        objectives=[SLOObjective("ttft", "ttft", threshold_s=1.0,
+                                 target=0.9)],
+        fast_window_s=60.0, slow_window_s=600.0, clock=lambda: 0.0, **kw)
+
+
+def test_goodput_ledger_windowed_ratios_and_burn_pinned():
+    reg = obs.MetricsRegistry()
+    led = _ttft_ledger(registry=reg)
+    assert led.goodput(None)["ttft"] is None  # no traffic: no number
+    assert led.burn(now=0.0)["ttft"] is None
+    for t in range(8):
+        led.record(_res(), now=float(t))
+    for t in range(8, 10):
+        led.record(_res(ttft_s=5.0), now=float(t))
+    assert led.goodput(None, now=10.0)["ttft"] == pytest.approx(0.8)
+    assert led.goodput(60.0, now=10.0)["ttft"] == pytest.approx(0.8)
+    # 20% bad in BOTH windows over a 10% budget: burn 2.0, and the
+    # mirrored gauge in the private registry carries the same number.
+    assert led.burn(now=10.0)["ttft"] == pytest.approx(2.0)
+    assert reg.snapshot()['serving_goodput_burn{objective="ttft"}'] \
+        == pytest.approx(2.0)
+    doc = led.snapshot(now=10.0)
+    assert doc["evaluated"] == 10 and doc["goodput_ratio"] \
+        == pytest.approx(0.8)
+
+
+def test_burn_is_an_and_gate_over_both_windows():
+    """A brief spike poisons the fast window only; min(fast, slow)
+    keeps the burn at the slow window's small bad fraction — no page
+    for a blip, exactly the multi-window semantics."""
+    led = _ttft_ledger()
+    for t in range(98):
+        led.record(_res(), now=float(t))  # old good traffic
+    for t in (500.0, 501.0):
+        led.record(_res(ttft_s=5.0), now=t)  # recent 2-request burst
+    assert led.goodput(60.0, now=501.0)["ttft"] == 0.0  # fast: all bad
+    # slow: 2 bad of 100 → 0.02 bad / 0.1 budget = 0.2, not 10.0.
+    assert led.burn(now=501.0)["ttft"] == pytest.approx(0.2)
+
+
+def test_burn_replay_is_bit_stable():
+    def run():
+        led = GoodputLedger(clock=lambda: 0.0,
+                            registry=obs.MetricsRegistry())
+        out = []
+        for t in range(40):
+            led.record(_res(ttft_s=5.0 if t % 7 == 0 else 0.05),
+                       now=float(t))
+            out.append(led.burn(now=float(t))["ttft"])
+        return out
+
+    assert run() == run()
+
+
+# -- engine wiring: scheduler → tracker, finished → ledger, canaries out ---
+
+
+def test_scheduler_feeds_load_tracker_every_step(compiled):
+    eng = _engine(compiled)
+    eng.result(eng.submit([5, 3, 9], max_new_tokens=4), timeout_s=120)
+    doc = eng.load.snapshot()
+    assert doc["observations"] > 0
+    assert 0.0 <= doc["score"] <= 1.0
+    assert doc["signals"]["max_slots"] == 3
+    assert doc["signals"]["queue_limit"] == 8
+
+
+def test_real_goodput_identical_with_canaries_on_and_off(compiled):
+    """THE exclusion pin: the same real traffic yields byte-identical
+    goodput accounting whether canary probes ride along or not."""
+
+    def serve(canaried):
+        eng = _engine(compiled, queue_depth=16)
+        driver = obs.CanaryDriver(eng) if canaried else None
+        for i in range(4):
+            if driver is not None and i % 2 == 0:
+                assert driver.probe()["ok"]
+            rid = eng.submit([5, 3, 9], max_new_tokens=4)
+            assert eng.result(rid, timeout_s=120).status == "completed"
+        return eng, driver
+
+    eng_off, _ = serve(False)
+    eng_on, driver = serve(True)
+    off, on = eng_off.slo.snapshot(), eng_on.slo.snapshot()
+    assert off["evaluated"] == on["evaluated"] == 4
+    assert off["goodput"]["lifetime"] == on["goodput"]["lifetime"]
+    assert on["goodput_ratio"] == 1.0
+    # The probes themselves WERE measured — as blackbox SLIs.
+    assert driver.probes == 2 and driver.failures == 0
+    snap = driver.snapshot()
+    assert snap["surface"] == "serving" and snap["e2e_s_avg"] is not None
+    assert eng_on._canary_ids == set()  # every probe id was claimed back
+
+
+def test_timed_out_request_burns_every_objective(compiled):
+    clock = FakeClock()
+    eng = _engine(compiled, max_slots=1, clock=clock)
+    busy = eng.submit([1, 2], max_new_tokens=50)
+    doomed = eng.submit([3, 4], max_new_tokens=5, timeout_s=2.0)
+    for _ in range(5):
+        clock.advance(0.5)  # 2.5 s total: past doomed's deadline, and
+        eng.step()          # busy's token gaps stay under the ITL bound
+    assert eng.result(doomed, timeout_s=10).status == "timeout"
+    assert eng.result(busy, timeout_s=120).status == "completed"
+    doc = eng.slo.snapshot()
+    assert doc["evaluated"] == 2
+    lifetime = doc["goodput"]["lifetime"]
+    assert lifetime["deadline"] == pytest.approx(0.5)
+    assert lifetime["ttft"] == pytest.approx(0.5)
+    assert lifetime["itl_p99"] == pytest.approx(0.5)
+    assert doc["goodput_ratio"] == pytest.approx(0.5)
+
+
+def test_canary_failure_is_counted_and_flight_noted(compiled):
+    eng = _engine(compiled, max_slots=1, queue_depth=2)
+    driver = obs.CanaryDriver(eng)
+    eng.submit([1, 2], max_new_tokens=2)
+    eng.submit([3, 4], max_new_tokens=2)
+    before = obs.default_flight_recorder().snapshot()[
+        "counts_by_kind"].get("canary_fail", 0)
+    rec = driver.probe()  # queue full: the blackbox sees a real reject
+    assert not rec["ok"] and "QueueFull" in rec["error"]
+    assert driver.failures == 1
+    assert eng._canary_ids == set()  # rejected probe id not left behind
+    assert obs.default_flight_recorder().snapshot()[
+        "counts_by_kind"]["canary_fail"] == before + 1
+    eng.run_until_drained()
+    assert driver.probe()["ok"]  # drained queue: the canary goes green
+    assert driver.probes == 2 and driver.failures == 1
+    assert driver.snapshot()["failure_ratio"] == pytest.approx(0.5)
+    # Real-traffic goodput never saw the probes: only the two real
+    # requests were evaluated.
+    assert eng.slo.snapshot()["evaluated"] == 2
+
+
+def test_engine_mount_ops_serves_saturation_routes(compiled):
+    import json
+    import urllib.request
+
+    def get_json(url):
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return json.loads(resp.read())
+
+    eng = _engine(compiled)
+    driver = obs.CanaryDriver(eng)
+    ops = eng.mount_ops(port=0)
+    try:
+        assert driver.probe()["ok"]
+        eng.result(eng.submit([5, 3], max_new_tokens=3), timeout_s=120)
+        doc = get_json(f"{ops.url}/load")
+        assert doc["observations"] > 0 and doc["score"] is not None
+        doc = get_json(f"{ops.url}/slo")
+        assert doc["evaluated"] == 1  # the canary probe is not in here
+        assert doc["goodput_ratio"] == 1.0
+        doc = get_json(f"{ops.url}/canary")
+        assert doc["surface"] == "serving"
+        assert doc["probes"] == 1 and doc["failures"] == 0
+        assert doc["last"]["ok"] is True
+    finally:
+        eng.unmount_ops()

@@ -473,3 +473,61 @@ def test_trainer_mounts_worker_role_endpoint():
     finally:
         trainer.unmount_ops()
     assert trainer.ops is None
+
+
+# -- saturation & goodput routes: /load, /slo, /canary ----------------------
+
+
+def test_load_slo_canary_routes_answer_empty_shells(ops):
+    """An unwired process answers the documented empty shells on all
+    three new routes — scrapers and the fleet aggregator deploy first,
+    engines wire in later."""
+    status, doc = _get_json(f"{ops.url}/load")
+    assert status == 200
+    assert doc == {"score": None, "raw": None, "observations": 0,
+                   "signals": None}
+    status, doc = _get_json(f"{ops.url}/slo")
+    assert status == 200
+    assert doc == {"objectives": [], "evaluated": 0, "goodput": {},
+                   "burn": {}, "goodput_ratio": None}
+    status, doc = _get_json(f"{ops.url}/canary")
+    assert status == 200
+    assert doc == {"surface": None, "probes": 0, "failures": 0,
+                   "failure_ratio": None, "last": None}
+
+
+def test_load_and_slo_routes_serve_wired_documents():
+    """Wired fns serve live documents: a LoadTracker snapshot (score +
+    raw anatomy) and a GoodputLedger snapshot, both on injected clocks."""
+    from types import SimpleNamespace
+
+    from elephas_tpu.obs import GoodputLedger, LoadTracker
+
+    tracker = LoadTracker(clock=lambda: 10.0)
+    tracker.observe(queue_depth=4, queue_limit=8, active=2, max_slots=4,
+                    kv_free_frac=0.5)
+    ledger = GoodputLedger(clock=lambda: 10.0, registry=MetricsRegistry())
+    ledger.record(SimpleNamespace(status="completed", ttft_s=0.1,
+                                  itl_s_avg=0.01))
+    server = OpsServer(port=0, registry=MetricsRegistry(),
+                       tracer=Tracer(annotate_device=False, enabled=False),
+                       flight=FlightRecorder(capacity=1),
+                       load_fn=tracker.snapshot, slo_fn=ledger.snapshot)
+    server.start()
+    try:
+        status, doc = _get_json(f"{server.url}/load")
+        assert status == 200
+        assert doc["observations"] == 1
+        assert doc["raw"] == pytest.approx(0.45)
+        assert doc["signals"]["occupancy"] == 0.5
+        assert doc["signals"]["queue_frac"] == 0.5
+
+        status, doc = _get_json(f"{server.url}/slo")
+        assert status == 200
+        assert doc["evaluated"] == 1
+        assert doc["goodput_ratio"] == 1.0
+        assert {o["name"] for o in doc["objectives"]} == \
+            {"ttft", "itl_p99", "deadline"}
+        assert doc["goodput"]["lifetime"]["ttft"] == 1.0
+    finally:
+        server.stop()

@@ -382,3 +382,70 @@ def test_live_kill_primary_promotes_standby(tmp_path):
     finally:
         client.close()
         group.stop()
+
+
+# --------------------------------------------------------------------------
+# Standby-lag gauge + per-shard canary probes
+# --------------------------------------------------------------------------
+
+
+def test_snapshot_publishes_standby_lag_gauge(tmp_path):
+    """Every snapshot()/check() pass refreshes the per-shard
+    ``ps_standby_lag_snapshots`` gauge from the WAL streamers — the
+    PR-9 gap: standby lag is now a fleet-visible number, not a private
+    streamer attribute."""
+    clock = FakeClock()
+    group = ShardGroup(_params(), 2, mode="socket", standby=1,
+                       wal_root=str(tmp_path), suspect_after=5.0,
+                       clock=clock)
+    group.start()
+    client = group.client()
+    gauge = obs.default_registry().gauge("ps_standby_lag_snapshots",
+                                         labelnames=("shard",))
+    try:
+        client.update_parameters(_delta(0))
+        assert _wait_for(lambda: group.streamer_of(0).lag() == 0)
+        assert _wait_for(lambda: group.streamer_of(1).lag() == 0)
+        snap = group.snapshot()
+        assert {row["shard"] for row in snap["standbys"]} == {0, 1}
+        assert all(row["lag"] == 0 for row in snap["standbys"])
+        for shard in ("0", "1"):
+            assert gauge.labels(shard=shard).value == 0.0
+    finally:
+        client.close()
+        group.stop()
+
+
+def test_ps_canary_probes_each_shard_without_perturbing_state(tmp_path):
+    """The blackbox PS canary: a plan-exact zero-delta tree pushed and
+    pulled through one sub-client per shard. Probes succeed, report
+    per-shard round trips + standby lag, and the parameter state is
+    digest-identical before and after — zeros apply additively."""
+    from elephas_tpu.obs.canary import PSCanary
+
+    group = ShardGroup(_params(), 2, mode="socket", standby=1,
+                       wal_root=str(tmp_path), suspect_after=5.0)
+    group.start()
+    client = group.client()
+    try:
+        client.update_parameters(_delta(3))
+        before = client.get_parameters()
+        canary = PSCanary(client, group=group)
+        doc = canary.probe()
+        assert doc["ok"] and len(doc["shards"]) == 2
+        assert all(s["rtt_s"] >= 0 for s in doc["shards"])
+        assert doc["rtt_s_max"] is not None
+        assert {row["shard"] for row in doc["standby_lag"]} == {0, 1}
+        # The zero delta bumped versions but changed no values.
+        after = client.get_parameters()
+        assert _tree_digest(after) == _tree_digest(before)
+        snap = canary.snapshot()
+        assert snap["surface"] == "ps" and snap["probes"] == 1
+        assert snap["failures"] == 0
+        # shard_client() bounds-checks: the probe surface can't silently
+        # target a shard outside the plan.
+        with pytest.raises(ValueError):
+            client.shard_client(2)
+    finally:
+        client.close()
+        group.stop()
