@@ -470,7 +470,7 @@ class FleetAggregator:
         # can mix newer engines with older procs (or fakes) that don't
         # serve them, and their absence must not fail the whole poll —
         # each is fetched in its own tolerant attempt.
-        for route in ("/load", "/slo"):
+        for route in ("/load", "/slo", "/replicas"):
             try:
                 scrape[route[1:]] = json.loads(
                     self.fetch(f"{entry.url}{route}", self.timeout))
@@ -524,6 +524,12 @@ class FleetAggregator:
                     for e in entries if "load" in e.scrape}
         per_slo = {e.name: e.scrape["slo"]
                    for e in entries if "slo" in e.scrape}
+        # A serving router's /replicas roster: only procs that serve
+        # the route (and returned a non-empty roster) contribute, so a
+        # mixed fleet of engines + one router reads naturally.
+        per_replicas = {e.name: e.scrape["replicas"]
+                        for e in entries
+                        if e.scrape.get("replicas", {}).get("replicas")}
         status_counts: Dict[str, int] = {}
         for e in entries:
             status_counts[e.status] = status_counts.get(e.status, 0) + 1
@@ -538,4 +544,5 @@ class FleetAggregator:
             "alerts": _merge_alerts(per_alerts),
             "load": per_load,
             "slo": per_slo,
+            "replicas": per_replicas,
         }

@@ -64,6 +64,10 @@ KINDS = (
     # goodput / canary plane (obs/slo.py, obs/canary.py)
     "goodput_burn",
     "canary_fail",
+    # serving fleet lifecycle + autoscaling (serving/fleet/)
+    "replica_drain",
+    "replica_restart",
+    "fleet_scale",
 )
 
 

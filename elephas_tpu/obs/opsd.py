@@ -29,7 +29,11 @@ debugger:
   per-device memory watermarks, ``?action=start[&dir=]`` /
   ``?action=stop`` to drive ``jax.profiler`` trace capture remotely;
 - ``GET /fleet``   — the merged fleet view, when this process hosts a
-  ``FleetAggregator`` (usually the one doing the polling).
+  ``FleetAggregator`` (usually the one doing the polling);
+- ``GET /replicas`` — the serving router's roster: per-replica
+  lifecycle state and dispatch signals, router affinity/requeue
+  counters, and the last autoscale decision
+  (``serving.fleet.Router.replicas_doc``).
 
 Routes are registered in an explicit table (``_add_route``), and the
 full vocabulary lives in the module-level ``ROUTES`` constant —
@@ -83,6 +87,7 @@ ROUTES = (
     "/load",
     "/slo",
     "/canary",
+    "/replicas",
 )
 
 
@@ -132,6 +137,9 @@ class OpsServer:
     canary_fn: the ``/canary`` payload (a ``CanaryDriver.snapshot`` /
         ``PSCanary.snapshot`` — blackbox probe SLIs); zero probes when
         unset.
+    replicas_fn: the ``/replicas`` payload (a serving fleet
+        ``Router.replicas_doc`` — replica roster + dispatch signals +
+        last autoscale decision); empty roster when unset.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
@@ -147,7 +155,8 @@ class OpsServer:
                  shards_fn: Optional[Callable[[], Dict]] = None,
                  load_fn: Optional[Callable[[], Dict]] = None,
                  slo_fn: Optional[Callable[[], Dict]] = None,
-                 canary_fn: Optional[Callable[[], Dict]] = None):
+                 canary_fn: Optional[Callable[[], Dict]] = None,
+                 replicas_fn: Optional[Callable[[], Dict]] = None):
         self._requested_port = port
         self.host = host if host is not None else _default_bind_host()
         self._registry = registry
@@ -167,6 +176,7 @@ class OpsServer:
         self._load_fn = load_fn
         self._slo_fn = slo_fn
         self._canary_fn = canary_fn
+        self._replicas_fn = replicas_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_wall = None
@@ -190,6 +200,7 @@ class OpsServer:
         self._add_route("/load", self._h_load)
         self._add_route("/slo", self._h_slo)
         self._add_route("/canary", self._h_canary)
+        self._add_route("/replicas", self._h_replicas)
 
     def _add_route(self, path: str, handler: Callable) -> None:
         self._routes[path] = handler
@@ -338,6 +349,11 @@ class OpsServer:
             return 200, self._canary_fn()
         return 200, {"surface": None, "probes": 0, "failures": 0,
                      "failure_ratio": None, "last": None}
+
+    def _h_replicas(self, query):
+        if self._replicas_fn is not None:
+            return 200, self._replicas_fn()
+        return 200, {"replicas": {}, "router": None, "autoscale": None}
 
     def start(self) -> "OpsServer":
         if self._httpd is not None:

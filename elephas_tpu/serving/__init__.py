@@ -22,7 +22,15 @@ decode step into an engine that serves request traffic:
                          (``serving.metrics``),
 - ``host_sync``        — the ONE sanctioned device→host sync point;
                          ``scripts/lint_blocking.py`` statically bans
-                         blocking reads anywhere else in this package.
+                         blocking reads anywhere else in this package,
+- ``fleet``            — the replicated layer above the engine: a
+                         ``ReplicaSet`` of N engine replicas with
+                         spawn/drain/kill/restart lifecycles, a
+                         signal-driven session-affinity ``Router`` that
+                         actuates on burn alerts and canary failures,
+                         and a ``FleetAutoscaler`` scaling replica
+                         count from multi-window burn
+                         (``serving.fleet``).
 
 The decode hot path is PIPELINED (one-step lookahead: dispatch N+1
 before reading N's tokens) and DONATION-CLEAN (the pool cache is donated
@@ -48,3 +56,11 @@ from elephas_tpu.serving.engine import (  # noqa: F401
     shard_serving,
 )
 from elephas_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from elephas_tpu.serving.fleet import (  # noqa: F401
+    FleetAutoscaler,
+    FleetUnavailable,
+    Replica,
+    ReplicaDead,
+    ReplicaSet,
+    Router,
+)

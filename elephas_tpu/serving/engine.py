@@ -185,6 +185,7 @@ class InferenceEngine:
         self._results: Dict[int, GenerationResult] = {}
         self._cond = threading.Condition()
         self._step_lock = threading.Lock()
+        self._halted = False  # see halt(): a dead engine never steps again
         self.ops = None  # OpsServer, mounted on demand
         # Canary exclusion: req_ids submitted with canary=True (guarded
         # by _cond). Their results still publish normally — the driver
@@ -448,9 +449,29 @@ class InferenceEngine:
         for r in real:
             self.slo.record(r)
 
+    def halt(self) -> None:
+        """Simulate process death for chaos harnesses: after any
+        in-flight step completes, the scheduler never advances again —
+        not from a serve thread, not from a ``result()`` caller
+        stepping inline. Queued and mid-decode requests freeze exactly
+        where the "process" died (the fleet router's requeue path is
+        what recovers them); already-published results stay claimable,
+        like reading a dead process's last output pipe."""
+        self._halted = True
+        with self._cond:
+            self._cond.notify_all()
+
+    @property
+    def halted(self) -> bool:
+        return self._halted
+
     def step(self) -> List[GenerationResult]:
         """One scheduler iteration; publishes finished results."""
+        if self._halted:
+            return []
         with self._step_lock:
+            if self._halted:
+                return []
             finished = self.scheduler.step()
         self._publish(finished)
         return finished
@@ -466,10 +487,10 @@ class InferenceEngine:
             with self._cond:
                 if req_id in self._results:
                     return self._results.pop(req_id)
-            if self._step_lock.acquire(blocking=False):
+            if not self._halted and self._step_lock.acquire(blocking=False):
                 # No server thread mid-step: advance the world ourselves.
                 try:
-                    finished = self.scheduler.step()
+                    finished = [] if self._halted else self.scheduler.step()
                 finally:
                     self._step_lock.release()
                 self._publish(finished)

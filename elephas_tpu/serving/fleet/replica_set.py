@@ -1,0 +1,86 @@
+"""The fleet roster: named replica slots with explicit lifecycles.
+
+A ``ReplicaSet`` owns N ``Replica`` slots and nothing else — no
+dispatch policy, no signals interpretation; that's the router's job.
+What it does own is *identity*: replica ids are assigned once
+(``r0``, ``r1``, ...) and dead replicas stay in the roster, because
+death is a state the fleet plane narrates (the aggregator's
+alive → stale → dead → alive arcs need the slot to persist across the
+outage), not an eviction. A restart is the same slot coming back with
+the next boot number; a scale-up is a genuinely new slot.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Dict, List
+
+from elephas_tpu.serving.fleet.replica import DEAD, SERVING, Replica
+
+__all__ = ["ReplicaSet"]
+
+
+class ReplicaSet:
+    """Spawn / drain / kill / restart replicas by id.
+
+    ``engine_factory`` is shared by every slot — each spawn builds a
+    fresh engine, so replicas never share queues or ledgers (they *do*
+    share compiled model state inside the factory's closure, which is
+    what makes an in-process fleet cheap enough to bench).
+    """
+
+    def __init__(self, engine_factory: Callable[[], Any], *,
+                 initial: int = 1,
+                 clock: Callable[[], float] = time.monotonic,
+                 mount_ops: bool = False):
+        if initial < 1:
+            raise ValueError(f"initial must be >= 1, got {initial}")
+        self.engine_factory = engine_factory
+        self.clock = clock
+        self.mount_ops = mount_ops
+        self._seq = itertools.count()
+        self.replicas: Dict[str, Replica] = {}
+        for _ in range(initial):
+            self.spawn()
+
+    def spawn(self) -> Replica:
+        """Add a new slot to the roster and boot it."""
+        rid = f"r{next(self._seq)}"
+        rep = Replica(rid, self.engine_factory, clock=self.clock,
+                      mount_ops=self.mount_ops)
+        rep.spawn()
+        self.replicas[rid] = rep
+        return rep
+
+    def get(self, replica_id: str) -> Replica:
+        return self.replicas[replica_id]
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def serving(self) -> List[Replica]:
+        """Replicas currently accepting new work, in id order."""
+        return [r for r in self.replicas.values() if r.state == SERVING]
+
+    def drain(self, replica_id: str, *, reason: str = "operator") -> None:
+        self.replicas[replica_id].drain(reason=reason)
+
+    def kill(self, replica_id: str) -> Replica:
+        rep = self.replicas[replica_id]
+        rep.kill()
+        return rep
+
+    def restart(self, replica_id: str, *,
+                reason: str = "operator") -> Replica:
+        return self.replicas[replica_id].restart(reason=reason)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-replica signal cards, keyed by replica id."""
+        return {rid: rep.signals() for rid, rep in self.replicas.items()}
+
+    def close(self) -> None:
+        """Teardown for benches/tests: hard-stop every live replica."""
+        for rep in self.replicas.values():
+            if rep.state != DEAD:
+                rep.kill()

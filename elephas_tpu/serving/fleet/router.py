@@ -1,0 +1,443 @@
+"""Admission router: the fleet's client-facing submit/result surface.
+
+The router reuses the scheduler/engine seam one level up — the same
+``submit() -> id`` / ``result(id)`` contract the engine offers, fronted
+over N replicas — so a client (and the benches, and the canary driver
+pointed at the router) cannot tell a fleet from a bare engine except
+by throughput. That contract is also the proof surface: with one
+replica and no faults, routed output must be token-identical to a bare
+engine's.
+
+Dispatch is signal-driven, not round-robin. Each submit ranks the
+serving replicas by a composite **dispatch cost** —
+
+    load_score  (the saturation plane's smoothed composite)
+  + w_q * queue_frac  (admission queue fullness)
+  + w_b * burn        (worst-objective goodput burn, capped)
+
+— with two overrides. **Session affinity**: a follow-up turn goes to
+the replica already holding that session's KV state, whatever its
+cost, because a re-prefill is pure waste; the pin breaks (and
+``affinity_miss_total`` counts it, per session card) only when that
+replica is draining, dead, or shedding. **Shed latch**: a replica
+whose ``goodput_burn_high`` alert latched ranks behind every clean
+replica and takes new work only when nothing clean is left.
+
+Actuation lives in ``tick()`` — explicitly driven (bench loop, test,
+ops cadence), never a hidden thread: finish drains, fire canary
+probes, refresh shed latches, drain-and-restart canary-flagged
+replicas, and feed the autoscaler, actuating its decision (spawn a new
+slot, or drain the cheapest one down). Recovery lives in ``result()``:
+a request stranded on a killed replica surfaces as ``ReplicaDead`` and
+is resubmitted elsewhere (``router_requeue_total``), with the router's
+own goodput ledger charging the *end-to-end* wait — a requeue stall is
+a real TTFT hit to the client even though the second replica's engine
+never saw it.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from elephas_tpu import obs
+from elephas_tpu.obs.slo import GoodputLedger
+from elephas_tpu.serving.fleet.replica import (
+    DRAINING,
+    Replica,
+    ReplicaDead,
+)
+from elephas_tpu.serving.fleet.replica_set import ReplicaSet
+from elephas_tpu.serving.scheduler import QueueFull
+
+__all__ = ["FleetUnavailable", "Router"]
+
+#: Dispatch-cost weights: load leads, queue pressure seconds it, burn
+#: is a tie-breaking nudge (the hard burn response is the shed latch,
+#: not the cost term).
+COST_QUEUE_WEIGHT = 0.5
+COST_BURN_WEIGHT = 0.25
+#: Burn saturates the cost term at critical territory (>6 is already
+#: page-worthy; beyond that the number carries no routing signal).
+BURN_COST_CAP = 8.0
+
+
+class FleetUnavailable(RuntimeError):
+    """No serving replica exists (all dead/draining) — distinct from
+    ``QueueFull``, where replicas exist but all rejected admission."""
+
+
+class _Assignment:
+    """Where one routed request currently lives (mutable: requeue
+    re-points it at a new replica/engine id)."""
+
+    __slots__ = ("router_id", "prompt", "kwargs", "session", "canary",
+                 "replica_id", "engine_rid", "t_router", "t_engine",
+                 "resubmits")
+
+    def __init__(self, router_id: int, prompt: Sequence[int],
+                 kwargs: Dict[str, Any], session: Optional[str],
+                 canary: bool, replica_id: str, engine_rid: int,
+                 t_router: float, t_engine: float):
+        self.router_id = router_id
+        self.prompt = prompt
+        self.kwargs = kwargs
+        self.session = session
+        self.canary = canary
+        self.replica_id = replica_id
+        self.engine_rid = engine_rid
+        self.t_router = t_router
+        self.t_engine = t_engine
+        self.resubmits = 0
+
+
+class _RouterOutcome:
+    """Duck-typed ``GenerationResult`` for the router's own goodput
+    ledger (``GoodputLedger.record`` reads only these three fields):
+    same objective semantics, but TTFT measured from the *router*
+    submit, so dispatch and requeue stalls land in the number."""
+
+    __slots__ = ("status", "ttft_s", "itl_s_avg")
+
+    def __init__(self, status: str, ttft_s: Optional[float],
+                 itl_s_avg: Optional[float]):
+        self.status = status
+        self.ttft_s = ttft_s
+        self.itl_s_avg = itl_s_avg
+
+
+class Router:
+    """Load- and goodput-driven admission frontend over a ``ReplicaSet``."""
+
+    def __init__(self, replica_set: ReplicaSet, *,
+                 clock=None, autoscaler=None,
+                 canary_fail_threshold: int = 1):
+        if canary_fail_threshold < 1:
+            raise ValueError(
+                f"canary_fail_threshold must be >= 1, "
+                f"got {canary_fail_threshold}")
+        self.replica_set = replica_set
+        self.clock = replica_set.clock if clock is None else clock
+        self.autoscaler = autoscaler
+        self.canary_fail_threshold = canary_fail_threshold
+        #: Router-relative goodput: the client's view of the fleet,
+        #: including dispatch and requeue stalls no single engine sees.
+        self.slo = GoodputLedger(clock=self.clock)
+
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._assignments: Dict[int, _Assignment] = {}
+        self._sessions: Dict[str, str] = {}
+        self._affinity: Dict[str, Dict[str, int]] = {}
+        self.ops = None
+
+        # Plain-int mirrors readable without a registry scrape; the
+        # counters are the dashboard surface.
+        self.requests = 0
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.requeues = 0
+        reg = obs.default_registry()
+        self._m_requests = reg.counter(
+            "router_requests_total",
+            help="requests admitted through the fleet router")
+        self._m_hit = reg.counter(
+            "affinity_hit_total",
+            help="session follow-ups dispatched to the replica already "
+                 "holding the session's KV state")
+        self._m_miss = reg.counter(
+            "affinity_miss_total",
+            help="session follow-ups re-routed because the pinned "
+                 "replica was draining, dead, or shedding")
+        self._m_requeue = reg.counter(
+            "router_requeue_total",
+            help="in-flight requests resubmitted to another replica "
+                 "after their replica died un-drained")
+
+    # -- dispatch ----------------------------------------------------------
+
+    def dispatch_cost(self, rep: Replica) -> float:
+        """Composite per-replica cost; lower routes first."""
+        burn = min(rep.worst_burn(), BURN_COST_CAP) / BURN_COST_CAP
+        return (rep.load_score()
+                + COST_QUEUE_WEIGHT * rep.queue_frac()
+                + COST_BURN_WEIGHT * burn)
+
+    def _dispatch_order(
+            self, pinned: Optional[str]) -> Tuple[List[Replica], bool]:
+        """Serving replicas in dispatch order, plus whether the
+        session pin held.
+
+        Clean replicas rank by cost ahead of every shedding one
+        (deterministic id tie-break). A healthy pinned replica jumps
+        the whole ranking; a shedding/draining/dead pin does not —
+        that's the explicit affinity miss."""
+        serving = self.replica_set.serving()
+        if not serving:
+            raise FleetUnavailable("no serving replica")
+        ranked = sorted(
+            serving,
+            key=lambda r: (r.shedding, self.dispatch_cost(r), r.replica_id))
+        if pinned is not None:
+            lead = next(
+                (r for r in ranked if r.replica_id == pinned), None)
+            if lead is not None and not lead.shedding:
+                return ([lead] + [r for r in ranked if r is not lead],
+                        True)
+        return ranked, False
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               *, session: Optional[str] = None,
+               timeout_s: Optional[float] = None,
+               canary: bool = False) -> int:
+        """Route one request; returns a router-scoped request id.
+
+        Raises ``FleetUnavailable`` when no replica is serving, or the
+        last replica's ``QueueFull`` when every one rejected admission.
+        """
+        t_router = self.clock()
+        with self._lock:
+            pinned = None if session is None else self._sessions.get(session)
+        order, pin_held = self._dispatch_order(pinned)
+        last_full = None
+        rep = None
+        engine_rid = None
+        for candidate in order:
+            try:
+                engine_rid = candidate.engine.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    timeout_s=timeout_s, canary=canary)
+            except QueueFull as err:
+                last_full = err
+                continue
+            rep = candidate
+            break
+        if rep is None:
+            raise last_full
+
+        self.requests += 1
+        self._m_requests.inc()
+        if pinned is not None:
+            card = self._affinity.setdefault(
+                rep.replica_id, {"hits": 0, "misses": 0})
+            if pin_held and rep.replica_id == pinned:
+                self.affinity_hits += 1
+                card["hits"] += 1
+                self._m_hit.inc()
+            else:
+                self.affinity_misses += 1
+                card["misses"] += 1
+                self._m_miss.inc()
+        rep.note_dispatch()
+
+        router_id = next(self._ids)
+        asg = _Assignment(
+            router_id, list(prompt),
+            {"max_new_tokens": max_new_tokens, "timeout_s": timeout_s,
+             "canary": canary},
+            session, canary, rep.replica_id, engine_rid,
+            t_router, self.clock())
+        with self._lock:
+            self._assignments[router_id] = asg
+            if session is not None:
+                self._sessions[session] = rep.replica_id
+        return router_id
+
+    # -- results + recovery ------------------------------------------------
+
+    def result(self, router_id: int,
+               timeout_s: Optional[float] = None):
+        """Claim a routed result, requeueing across replica death.
+
+        A ``ReplicaDead`` from the assigned replica resubmits the
+        request on the next-best replica and keeps waiting — the
+        client sees one slower result, never the outage.
+        """
+        with self._lock:
+            asg = self._assignments.get(router_id)
+        if asg is None:
+            raise KeyError(f"unknown router request id {router_id}")
+        deadline = (None if timeout_s is None
+                    else self.clock() + timeout_s)
+        while True:
+            rep = self.replica_set.get(asg.replica_id)
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - self.clock()))
+            try:
+                res = rep.result(asg.engine_rid, timeout_s=remaining)
+            except ReplicaDead:
+                self._requeue(asg)
+                continue
+            rep.note_done()
+            with self._lock:
+                self._assignments.pop(router_id, None)
+            if not asg.canary:
+                ttft = (None if res.ttft_s is None
+                        else (asg.t_engine - asg.t_router) + res.ttft_s)
+                self.slo.record(
+                    _RouterOutcome(res.status, ttft, res.itl_s_avg))
+            return res
+
+    def _requeue(self, asg: _Assignment) -> None:
+        """Move a stranded assignment off its dead replica."""
+        dead_id = asg.replica_id
+        self.replica_set.get(dead_id).note_done()
+        order, _ = self._dispatch_order(None)
+        rep = None
+        engine_rid = None
+        last_full = None
+        for candidate in order:
+            if candidate.replica_id == dead_id:
+                continue
+            try:
+                engine_rid = candidate.engine.submit(
+                    asg.prompt, **asg.kwargs)
+            except QueueFull as err:
+                last_full = err
+                continue
+            rep = candidate
+            break
+        if rep is None:
+            if last_full is not None:
+                raise last_full
+            raise ReplicaDead(dead_id, asg.engine_rid)
+        rep.note_dispatch()
+        self.requeues += 1
+        self._m_requeue.inc()
+        with self._lock:
+            asg.replica_id = rep.replica_id
+            asg.engine_rid = engine_rid
+            asg.resubmits += 1
+            asg.t_engine = self.clock()
+            if (asg.session is not None
+                    and self._sessions.get(asg.session) == dead_id):
+                self._sessions[asg.session] = rep.replica_id
+
+    # -- actuation ---------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None,
+             *, probe: bool = False) -> Dict[str, Any]:
+        """One actuation pass (explicitly driven — no hidden thread).
+
+        1. Close out drains whose replicas went idle; restart the
+           canary-flagged ones (autoscaler drains stay down).
+        2. Per serving replica: optionally fire one blackbox canary
+           probe, refresh the latched burn alerts (shed state), and
+           drain-and-restart any replica with fresh canary failures.
+        3. Feed the worst serving burn to the autoscaler and actuate
+           its decision.
+
+        Returns a summary of what actuated, for benches and logs.
+        """
+        now = self.clock() if now is None else now
+        actions: Dict[str, Any] = {
+            "drain_finished": [], "restarted": [], "canary_drained": [],
+            "scale": None,
+        }
+        for rep in list(self.replica_set.replicas.values()):
+            if rep.state == DRAINING and rep.maybe_finish_drain():
+                actions["drain_finished"].append(rep.replica_id)
+                if rep.pending_restart and not rep.scale_down:
+                    rep.restart(reason="canary")
+                    actions["restarted"].append(rep.replica_id)
+        for rep in self.replica_set.serving():
+            if probe and rep.canary is not None:
+                rep.canary.probe()
+            rep.evaluate_alerts(now)
+            fresh = (0 if rep.canary is None
+                     else rep.canary.failures - rep.seen_canary_failures)
+            if fresh >= self.canary_fail_threshold:
+                rep.seen_canary_failures = rep.canary.failures
+                rep.pending_restart = True
+                rep.drain(reason="canary_failures")
+                actions["canary_drained"].append(rep.replica_id)
+        if self.autoscaler is not None:
+            serving = self.replica_set.serving()
+            if serving:
+                burn = max(r.worst_burn() for r in serving)
+                decision = self.autoscaler.observe(
+                    burn=burn, n_replicas=len(serving), now=now)
+                if decision == "up":
+                    self.replica_set.spawn()
+                elif decision == "down":
+                    victim = min(
+                        serving,
+                        key=lambda r: (self.dispatch_cost(r),
+                                       r.replica_id))
+                    victim.scale_down = True
+                    victim.drain(reason="scale_down")
+                actions["scale"] = decision
+        return actions
+
+    # -- introspection -----------------------------------------------------
+
+    def session_replica(self, session: str) -> Optional[str]:
+        """Which replica holds this session's KV state (None if the
+        session is unknown) — benches use it to aim kills."""
+        with self._lock:
+            return self._sessions.get(session)
+
+    def replicas_doc(self) -> Dict[str, Any]:
+        """The ``/replicas`` ops document: per-replica signal cards
+        plus router counters and the autoscaler's policy card."""
+        with self._lock:
+            sessions = len(self._sessions)
+            in_flight = len(self._assignments)
+            affinity = {rid: dict(card)
+                        for rid, card in self._affinity.items()}
+        replicas: Dict[str, Any] = {}
+        for rid, rep in self.replica_set.replicas.items():
+            card = rep.signals()
+            card["affinity"] = affinity.get(rid, {"hits": 0, "misses": 0})
+            replicas[rid] = card
+        return {
+            "replicas": replicas,
+            "router": {
+                "requests": self.requests,
+                "affinity_hits": self.affinity_hits,
+                "affinity_misses": self.affinity_misses,
+                "requeues": self.requeues,
+                "sessions": sessions,
+                "in_flight": in_flight,
+            },
+            "autoscale": (None if self.autoscaler is None
+                          else self.autoscaler.snapshot()),
+        }
+
+    def mount_ops(self, port: int = 0, host: Optional[str] = None):
+        """Serve the router's own ops endpoint (role ``router``): the
+        fleet aggregator polls it like any process and picks the
+        ``/replicas`` roster out of the tolerant scrape."""
+        if self.ops is not None:
+            return self.ops
+        from elephas_tpu.obs.opsd import OpsServer
+
+        self.ops = OpsServer(
+            port=port, host=host, role="router",
+            vars_fn=lambda: {
+                "role": "router",
+                "replicas": len(self.replica_set),
+                "serving": len(self.replica_set.serving()),
+            },
+            health_fn=lambda: {
+                "healthy": bool(self.replica_set.serving()),
+                "serving": len(self.replica_set.serving()),
+                "requests": self.requests,
+                "requeues": self.requeues,
+            },
+            slo_fn=self.slo.snapshot,
+            replicas_fn=self.replicas_doc,
+        ).start()
+        return self.ops
+
+    def unmount_ops(self) -> None:
+        if self.ops is not None:
+            self.ops.stop()
+            self.ops = None
+
+    def close(self) -> None:
+        """Teardown for benches/tests."""
+        self.unmount_ops()
+        self.replica_set.close()

@@ -2,7 +2,8 @@
 """Bench gate: diff a fresh bench run against the committed artifact.
 
 The repo commits its measured baselines (``BENCH_SERVE.json``,
-``BENCH_PS.json``, ``BENCH_CHAOS.json``); a perf regression today is
+``BENCH_PS.json``, ``BENCH_CHAOS.json``, ``BENCH_FLEET.json``); a perf
+regression today is
 only caught by a human re-reading numbers. This gate makes the diff
 mechanical: re-run the bench, hand both files to ``bench_gate.py``, and
 get a machine-readable verdict — one check per (row, metric) with the
@@ -10,7 +11,8 @@ threshold that was applied, and a process exit code CI can gate on.
 
 Matching: rows are joined on an artifact-specific identity key (serving
 rows on ``(mode, pipeline)``, PS rows on ``(mode, codec, op, quantize,
-pipelined)``, chaos rows on ``scenario``) — never on position, so
+pipelined)``, chaos rows on ``scenario``, fleet rows on ``mode``) —
+never on position, so
 re-ordered or appended rows don't misalign the diff. A baseline row
 missing from the fresh run fails; extra fresh rows are ignored (a new
 bench mode is not a regression).
@@ -129,6 +131,48 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             # through the real sharded-client path must SEE the outage
             # (failed probes on the killed shard) and see it end.
             ("canary_saw_outage", "equal", 0.0),
+        ],
+    ),
+    "fleet": (
+        ("mode",),
+        [
+            # Routed-vs-bare guardrail: one replica behind the router
+            # must cost < 2% throughput vs the bare engine — same
+            # absolute-ceiling discipline as the trace/canary
+            # overheads, measured with the same best-of-rounds
+            # alternation.
+            ("routed_overhead_pct", "limit", 2.0),
+            # And the routed stream must be the SAME stream: token
+            # identity is the router's correctness proof, not a perf
+            # number.
+            ("token_identical", "equal", 0.0),
+            ("tokens_per_sec", "higher", 0.35),
+            ("all_completed", "equal", 0.0),
+            # Session affinity must actually hold under steady
+            # multi-turn traffic: a follow-up turn that re-prefills on
+            # a different replica is wasted work the signals should
+            # have prevented. Absolute floor, not baseline-relative.
+            ("affinity_hit_rate", "floor", 0.90),
+            # Kill-mid-traffic row: the fleet plane must SEE the
+            # replica die (dead in its transition arc) and come back,
+            # replay-stably — the fleet_saw_outage discipline applied
+            # to a serving replica.
+            ("fleet_saw_replica_outage", "equal", 0.0),
+            # Blackbox outage as the router's clients experience it:
+            # canary probes routed through the fleet during the kill.
+            # Ceiling sized as kill detection (one result slice) plus
+            # requeue + re-prefill of the probe, with CI headroom.
+            ("outage_canary_s", "limit", 10.0),
+            # Real-goodput dip bound for the same window: requeued
+            # requests pay dispatch+re-prefill once, they don't fail —
+            # worst-objective attainment stays above half even while
+            # the fleet is one replica down.
+            ("goodput_ratio_after_kill", "floor", 0.50),
+            # Autoscaler proof bits: under the seeded burst the
+            # decision sequence must contain the scale-up, and the
+            # post-cooldown quiet window must produce the scale-down.
+            ("scaled_up_under_burst", "equal", 0.0),
+            ("scaled_down_after_cooldown", "equal", 0.0),
         ],
     ),
 }
@@ -251,7 +295,7 @@ def main(argv: Optional[List[str]] = None) -> dict:
         if pair is not None:
             pairs[kind] = (load_rows(pair[0]), load_rows(pair[1]))
     if not pairs:
-        ap.error("give at least one of --serve/--ps/--chaos")
+        ap.error("give at least one of --serve/--ps/--chaos/--fleet")
     verdict = gate(pairs)
     text = json.dumps(verdict, indent=1)
     print(text)

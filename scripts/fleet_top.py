@@ -7,7 +7,11 @@ process's opsd URL and get the merged picture — who is alive/stale/dead
 back different), per-process LOAD (EWMA saturation score from ``/load``)
 and GOODPUT (worst-objective SLO attainment from ``/slo``; both render
 ``-`` for stale/dead procs), the fleet-summed counters, pooled histogram
-percentiles, cluster worker ledger, and active alerts.
+percentiles, cluster worker ledger, and active alerts. A process whose
+``/replicas`` roster is non-empty (a fleet router) also gets a replica
+board: per-replica lifecycle STATE, boot, LOAD, affinity hit-rate,
+in-flight count, and worst burn — all ``-`` when the router itself went
+stale/dead, and the signal columns ``-`` for dead replicas.
 
 Usage:
     python scripts/fleet_top.py http://127.0.0.1:8801 http://127.0.0.1:8802
@@ -58,6 +62,29 @@ def _goodput_cell(snap: dict, name: str, status: str) -> str:
     return f"{100.0 * ratio:.1f}%" if ratio is not None else "-"
 
 
+def _replica_cells(rid: str, card: dict, proc_status: str) -> str:
+    """One row of the replica board. Every signal column renders '-'
+    when the router process itself is stale/dead (its roster stopped
+    updating) and for dead replicas (their signals are None by
+    construction — a dead engine has no load score)."""
+    alive = proc_status == "alive"
+
+    def num(v):
+        return f"{v:.2f}" if alive and v is not None else "-"
+
+    aff = card.get("affinity") or {}
+    hits = aff.get("hits", 0)
+    misses = aff.get("misses", 0)
+    total = hits + misses
+    rate = f"{100.0 * hits / total:.0f}%" if alive and total else "-"
+    state = str(card.get("state", "?")) if alive else "-"
+    boot = str(card.get("boot", "-")) if alive else "-"
+    inflt = str(card.get("in_flight", "-")) if alive else "-"
+    return (f"{rid:<9} {state:<9} {boot:>4} "
+            f"{num(card.get('load_score')):>6} {rate:>8} {inflt:>6} "
+            f"{num(card.get('burn_worst')):>6}")
+
+
 def render(snap: dict) -> str:
     """The merged fleet snapshot as a fixed-width text board."""
     lines: List[str] = []
@@ -98,6 +125,22 @@ def render(snap: dict) -> str:
             lines.append(f"  {key:<42} {h['count']:>8} "
                          f"{fmt(h['p50']):>10} {fmt(h['p95']):>10} "
                          f"{fmt(h['p99']):>10}")
+    for proc, doc in sorted((snap.get("replicas") or {}).items()):
+        proc_status = (snap["processes"].get(proc) or {}).get("status", "?")
+        router = doc.get("router") or {}
+
+        def rstat(key):
+            v = router.get(key)
+            return v if proc_status == "alive" and v is not None else "-"
+
+        lines.append("")
+        lines.append(f"replicas via {proc}: requests={rstat('requests')} "
+                     f"requeues={rstat('requeues')} "
+                     f"sessions={rstat('sessions')}")
+        lines.append(f"  {'REPLICA':<9} {'STATE':<9} {'BOOT':>4} "
+                     f"{'LOAD':>6} {'AFF HIT':>8} {'INFLT':>6} {'BURN':>6}")
+        for rid, card in sorted((doc.get("replicas") or {}).items()):
+            lines.append("  " + _replica_cells(rid, card, proc_status))
     workers = snap["workers"]
     if workers["workers"]:
         lines.append("")

@@ -217,10 +217,11 @@ def lint_file(path: Path) -> List[Violation]:
 
 
 def lint_package(root: Path) -> List[Violation]:
-    """Lint every module in the serving package except the sanctioned
-    sync point itself."""
+    """Lint every module in the serving package — recursively, so
+    subpackages (``serving/fleet/``) inherit the blocking-read and
+    clock-call bans — except the sanctioned sync point itself."""
     out = []
-    for path in sorted(root.glob("*.py")):
+    for path in sorted(root.rglob("*.py")):
         if path.name == SANCTIONED:
             continue
         out.extend(lint_file(path))

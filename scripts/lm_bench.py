@@ -18,7 +18,14 @@ artifact (``--out BENCH_DECODE.json``):
   before/after of pipelining directly; reports engine tokens/sec, TTFT,
   dispatch→fetch overlap, prefill/decode compile counts. The serving
   arms also land in their own artifact via ``--serve-out
-  BENCH_SERVE.json``.
+  BENCH_SERVE.json``,
+- ``{"mode": "fleet_*", ...}`` (``--fleet`` → ``--fleet-out
+  BENCH_FLEET.json``) — the replicated fleet: routed-vs-bare overhead
+  with token-identity proof, N-replica session-affinity throughput,
+  the kill-a-replica-mid-traffic chaos arm (fleet-plane outage arc,
+  blackbox canary outage, goodput dip, requeue recovery), and the
+  autoscaler's seeded decision replay. Gated by scripts/bench_gate.py
+  ``--fleet``.
 
 Importable (and runnable with tiny defaults) without a TPU — tier-1
 collects it; real numbers come from the dev chip.
@@ -332,6 +339,347 @@ def bench_slo(compiled, max_slots: int, prompt_len: int, new_tokens: int,
     }
 
 
+# -- fleet arms (--fleet → BENCH_FLEET.json) ---------------------------------
+
+
+def _engine_factory(compiled, max_slots, prompt_len, new_tokens, depth):
+    from elephas_tpu.serving import InferenceEngine
+
+    def factory():
+        return InferenceEngine(
+            compiled,
+            max_slots=max_slots,
+            max_prompt_len=prompt_len,
+            max_len=prompt_len + new_tokens + 1,
+            queue_depth=depth,
+            pipeline=True,
+        )
+
+    return factory
+
+
+def _fleet_workload(submit, result, vocab, prompt_len, new_tokens,
+                    requests):
+    """The standard mixed-length workload against any submit/result
+    pair (bare engine or router) — same seed, same prompts, so the two
+    arms' token streams are comparable request-for-request."""
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+    t0 = time.perf_counter()
+    rids = []
+    for _ in range(requests):
+        plen = int(rng.integers(1, prompt_len + 1))
+        prompt = rng.integers(1, vocab, plen).tolist()
+        rids.append(submit(prompt, new_tokens))
+    results = [result(r) for r in rids]
+    dt = time.perf_counter() - t0
+    tokens = [list(r.tokens) for r in results]
+    tps = sum(len(t) for t in tokens) / dt
+    return tps, tokens, results
+
+
+def bench_fleet_routed_vs_bare(compiled, max_slots: int, prompt_len: int,
+                               new_tokens: int, requests: int,
+                               rounds: int = 3, attempts: int = 3) -> dict:
+    """Routing guardrail + correctness proof: a single replica behind
+    the router must serve the SAME token streams as a bare engine
+    (request-for-request identity) at < 2% throughput cost. Both arms
+    run a serve thread (the replica's is built in), so the comparison
+    isolates the router hop, not a stepping-discipline difference.
+    Measured with the trace-overhead discipline: discarded warmup, then
+    ``rounds`` bare/routed pairs with alternating within-pair order,
+    compared best-of-rounds, retried ``attempts`` times."""
+    import threading
+
+    from elephas_tpu.serving import ReplicaSet, Router
+
+    vocab = compiled.module.vocab_size
+    factory = _engine_factory(compiled, max_slots, prompt_len, new_tokens,
+                              max(requests, 1) + 1)
+
+    def run_bare():
+        engine = factory()
+        stop = threading.Event()
+        th = threading.Thread(target=engine.serve_forever, args=(stop,),
+                              daemon=True)
+        th.start()
+        engine.result(engine.submit([1] * prompt_len, max_new_tokens=2),
+                      timeout_s=60.0)
+        out = _fleet_workload(
+            lambda p, n: engine.submit(p, max_new_tokens=n),
+            lambda r: engine.result(r, timeout_s=120.0),
+            vocab, prompt_len, new_tokens, requests)
+        stop.set()
+        th.join(timeout=10.0)
+        return out
+
+    def run_routed():
+        rs = ReplicaSet(factory, initial=1)
+        router = Router(rs)
+        router.result(router.submit([1] * prompt_len, max_new_tokens=2),
+                      timeout_s=60.0)
+        out = _fleet_workload(
+            lambda p, n: router.submit(p, max_new_tokens=n),
+            lambda r: router.result(r, timeout_s=120.0),
+            vocab, prompt_len, new_tokens, requests)
+        router.close()
+        return out
+
+    run_bare()  # warmup (compile + caches), discarded
+    for attempt in range(attempts):
+        bare, routed = [], []
+        for r in range(rounds):
+            if r % 2 == 0:
+                bare.append(run_bare())
+                routed.append(run_routed())
+            else:
+                routed.append(run_routed())
+                bare.append(run_bare())
+        overhead = 1.0 - (max(x[0] for x in routed)
+                          / max(x[0] for x in bare))
+        if overhead < 0.02:
+            break
+    token_identical = all(x[1] == bare[0][1] for x in bare + routed)
+    all_completed = all(
+        res.status == "completed" for x in bare + routed for res in x[2])
+    rec = {
+        "mode": "fleet_routed_vs_bare",
+        "max_slots": max_slots,
+        "requests": requests,
+        "rounds": rounds,
+        "attempts_used": attempt + 1,
+        "tokens_per_sec_bare": max(x[0] for x in bare),
+        "tokens_per_sec_routed": max(x[0] for x in routed),
+        "routed_overhead_pct": overhead * 100.0,
+        "token_identical": token_identical,
+        "all_completed": all_completed,
+        "within_2pct": overhead < 0.02,
+    }
+    assert token_identical, "routed token streams diverged from bare engine"
+    assert rec["within_2pct"], (
+        f"router overhead {overhead * 100.0:.2f}% >= 2% after "
+        f"{attempts} attempts"
+    )
+    return rec
+
+
+def bench_fleet_n(compiled, max_slots: int, prompt_len: int,
+                  new_tokens: int, *, replicas: int = 3,
+                  sessions: int = 6, turns: int = 4) -> dict:
+    """N-replica steady state: multi-turn sessions through the router.
+    Every turn after a session's first should land on the replica
+    holding its KV state — the committed ``affinity_hit_rate`` is the
+    floor the gate holds (0.9; it measures 1.0 when nothing drains)."""
+    import numpy as np
+
+    from elephas_tpu.serving import ReplicaSet, Router
+
+    vocab = compiled.module.vocab_size
+    factory = _engine_factory(compiled, max_slots, prompt_len, new_tokens,
+                              sessions + replicas)
+    rs = ReplicaSet(factory, initial=replicas)
+    router = Router(rs)
+    # Warm every replica's engine paths (spread by queue pressure).
+    warm = [router.submit([1] * prompt_len, max_new_tokens=2)
+            for _ in range(2 * replicas)]
+    for r in warm:
+        router.result(r, timeout_s=60.0)
+
+    rng = np.random.default_rng(7)
+    names = [f"s{i}" for i in range(sessions)]
+    total_tokens = 0
+    results = []
+    t0 = time.perf_counter()
+    for _turn in range(turns):
+        rids = []
+        for s in names:
+            plen = int(rng.integers(1, prompt_len + 1))
+            prompt = rng.integers(1, vocab, plen).tolist()
+            rids.append(router.submit(prompt, max_new_tokens=new_tokens,
+                                      session=s))
+        for r in rids:
+            res = router.result(r, timeout_s=120.0)
+            results.append(res)
+            total_tokens += len(res.tokens)
+    dt = time.perf_counter() - t0
+    follow_ups = router.affinity_hits + router.affinity_misses
+    rec = {
+        "mode": "fleet_n3",
+        "replicas": replicas,
+        "sessions": sessions,
+        "turns": turns,
+        "requests": sessions * turns,
+        "tokens_out": total_tokens,
+        "wall_sec": dt,
+        "tokens_per_sec": total_tokens / dt,
+        "affinity_hits": router.affinity_hits,
+        "affinity_misses": router.affinity_misses,
+        "affinity_hit_rate": (router.affinity_hits / follow_ups
+                              if follow_ups else None),
+        "all_completed": all(r.status == "completed" for r in results),
+    }
+    router.close()
+    return rec
+
+
+def bench_fleet_kill(compiled, max_slots: int, prompt_len: int,
+                     new_tokens: int, *, replicas: int = 3) -> dict:
+    """Chaos arm: kill a replica mid-traffic and measure the outage
+    from three vantage points at once — the fleet plane (the killed
+    replica's alive→stale→dead→alive transition arc through real HTTP
+    scrapes), the blackbox clients (canary probes routed through the
+    fleet during the outage — the router should mask most or all of
+    it), and the real goodput ledger (requeued requests pay a bounded
+    TTFT hit, they don't fail)."""
+    import threading
+
+    from elephas_tpu.obs.fleet import FleetAggregator
+    from elephas_tpu.serving import ReplicaSet, Router
+
+    vocab = compiled.module.vocab_size
+    requests = 3 * replicas
+    factory = _engine_factory(compiled, max_slots, prompt_len, new_tokens,
+                              requests + 4)
+    rs = ReplicaSet(factory, initial=replicas, mount_ops=True)
+    router = Router(rs)
+    router.mount_ops(port=0)
+
+    agg = FleetAggregator(dead_after=1.0, timeout=1.0)
+    for rid, rep in rs.replicas.items():
+        agg.add(f"http://127.0.0.1:{rep.engine.ops.port}", name=rid)
+    agg.add(f"http://127.0.0.1:{router.ops.port}", name="router")
+    poll_stop = threading.Event()
+
+    def poller():
+        while not poll_stop.is_set():
+            agg.poll()
+            poll_stop.wait(0.15)
+
+    poll_thread = threading.Thread(target=poller, daemon=True)
+    poll_thread.start()
+
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    names = [f"s{i}" for i in range(2 * replicas)]
+    # First turn pins every session somewhere (and warms the engines).
+    for s in names:
+        router.result(router.submit([1, 2, 3], max_new_tokens=2,
+                                    session=s), timeout_s=60.0)
+    victim = router.session_replica(names[0])
+
+    # Long decodes in flight across the fleet, then kill the pinned
+    # replica under them.
+    rids = []
+    for i in range(requests):
+        plen = int(rng.integers(1, prompt_len + 1))
+        prompt = rng.integers(1, vocab, plen).tolist()
+        rids.append(router.submit(prompt, max_new_tokens=new_tokens,
+                                  session=names[i % len(names)]))
+    t_kill = time.perf_counter()
+    rs.kill(victim)
+
+    # Blackbox canary probes through the router while degraded.
+    probes = []
+    while time.perf_counter() - t_kill < 1.5:
+        t_p = time.perf_counter()
+        try:
+            pid = router.submit([1, 2, 3], max_new_tokens=2, canary=True)
+            ok = router.result(pid, timeout_s=5.0).status == "completed"
+        except Exception:
+            ok = False
+        probes.append((t_p - t_kill, ok))
+        time.sleep(0.05)
+    fails = [t for t, ok in probes if not ok]
+    outage_canary_s = (max(fails) - min(fails)) + 0.05 if fails else 0.0
+
+    results = [router.result(r, timeout_s=120.0) for r in rids]
+    misses_after_kill = router.affinity_misses
+
+    # Restart the victim (same name, new boot, new port) and wait for
+    # the fleet plane to narrate the full arc.
+    while time.perf_counter() - t_kill < 2.0:
+        time.sleep(0.05)
+    rs.restart(victim)
+    agg.add(f"http://127.0.0.1:{rs.get(victim).engine.ops.port}",
+            name=victim)
+    saw_outage = False
+    t_recover = None
+    deadline = time.perf_counter() + 20.0
+    while time.perf_counter() < deadline:
+        proc = agg.snapshot()["processes"].get(victim)
+        if proc is not None:
+            states = [s for _, s in proc["transitions"]]
+            if "dead" in states and proc["status"] == "alive":
+                saw_outage = True
+                t_recover = time.perf_counter() - t_kill
+                break
+        time.sleep(0.1)
+    poll_stop.set()
+    poll_thread.join(timeout=5.0)
+
+    slo = router.slo.snapshot()
+    rec = {
+        "mode": "fleet_kill",
+        "replicas": replicas,
+        "requests": requests,
+        "victim": victim,
+        "requeues": router.requeues,
+        "affinity_misses_after_kill": misses_after_kill,
+        "canary_probes": len(probes),
+        "canary_failed_probes": len(fails),
+        "outage_canary_s": outage_canary_s,
+        "fleet_saw_replica_outage": saw_outage,
+        "fleet_recover_s": t_recover,
+        "goodput_ratio_after_kill": slo["goodput_ratio"],
+        "all_completed": all(r.status == "completed" for r in results),
+        "victim_boot_after": rs.get(victim).boot,
+    }
+    router.close()
+    return rec
+
+
+def bench_fleet_autoscale() -> dict:
+    """Autoscaler replay arm: a seeded burn ladder (burst, then quiet)
+    through the pure decision core. No engines, no clocks — the
+    committed decision sequence IS the replay baseline; the gate's
+    equal-rules hold the scale-up-under-burst and
+    scale-down-after-cooldown bits."""
+    from elephas_tpu.serving import FleetAutoscaler
+
+    auto = FleetAutoscaler(min_replicas=1, max_replicas=3, up_burn=1.0,
+                           down_burn=0.25, up_after=2, down_after=3,
+                           cooldown_s=60.0)
+    schedule = []
+    t = 0.0
+    for _ in range(4):          # seeded burst: sustained critical burn
+        schedule.append((t, 5.0))
+        t += 10.0
+    for _ in range(12):         # quiet tail: budget recovered
+        schedule.append((t, 0.0))
+        t += 30.0
+    n = 1
+    for t_obs, burn in schedule:
+        decision = auto.observe(burn=burn, n_replicas=n, now=t_obs)
+        if decision == "up":
+            n += 1
+        elif decision == "down":
+            n -= 1
+    ups = [d["t"] for d in auto.decisions if d["direction"] == "up"]
+    downs = [d["t"] for d in auto.decisions if d["direction"] == "down"]
+    return {
+        "mode": "fleet_autoscale",
+        "observations": auto.observations,
+        "decisions": [[d["t"], d["direction"], d["replicas"]]
+                      for d in auto.decisions],
+        "scaled_up_under_burst": bool(ups) and ups[0] <= 40.0,
+        "scaled_down_after_cooldown": (bool(ups) and bool(downs)
+                                       and downs[0] >= ups[0] + 60.0),
+        "final_replicas": n,
+    }
+
+
 def main(argv=None) -> list:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--batches", type=int, nargs="+", default=[1, 8, 32])
@@ -361,6 +709,18 @@ def main(argv=None) -> list:
                              "(SLO attainment ratios, canary probe SLIs, "
                              "and the canaried-vs-plain < 2%% overhead "
                              "measurement)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="run the replicated-fleet arms: routed-vs-"
+                             "bare overhead + token identity, N-replica "
+                             "session-affinity throughput, kill-a-"
+                             "replica-mid-traffic chaos, and the "
+                             "autoscaler decision replay")
+    parser.add_argument("--fleet-out", type=str, default=None,
+                        help="write the fleet arms as their own JSON "
+                             "artifact (BENCH_FLEET.json)")
+    parser.add_argument("--fleet-replicas", type=int, default=3)
+    parser.add_argument("--fleet-sessions", type=int, default=6)
+    parser.add_argument("--fleet-turns", type=int, default=4)
     args = parser.parse_args(argv)
 
     import jax
@@ -409,6 +769,27 @@ def main(argv=None) -> list:
         serving_records.append(rec)
         records.append(rec)
         print(json.dumps(rec))
+    fleet_records = []
+    if args.fleet:
+        for rec in (
+            bench_fleet_routed_vs_bare(
+                compiled, args.serving_slots, args.prompt_len, args.new,
+                args.serving_requests,
+            ),
+            bench_fleet_n(
+                compiled, args.serving_slots, args.prompt_len, args.new,
+                replicas=args.fleet_replicas,
+                sessions=args.fleet_sessions, turns=args.fleet_turns,
+            ),
+            bench_fleet_kill(
+                compiled, args.serving_slots, args.prompt_len, args.new,
+                replicas=args.fleet_replicas,
+            ),
+            bench_fleet_autoscale(),
+        ):
+            fleet_records.append(rec)
+            records.append(rec)
+            print(json.dumps(rec))
     if args.trace:
         from elephas_tpu.obs import Tracer
 
@@ -432,6 +813,9 @@ def main(argv=None) -> list:
     if args.serve_out:
         with open(args.serve_out, "w") as f:
             json.dump([records[0], *serving_records], f, indent=1)
+    if args.fleet_out:
+        with open(args.fleet_out, "w") as f:
+            json.dump([records[0], *fleet_records], f, indent=1)
     return records
 
 

@@ -150,7 +150,8 @@ def test_committed_artifacts_self_compare():
     pairs = {}
     for kind, name in (("serve", "BENCH_SERVE.json"),
                        ("ps", "BENCH_PS.json"),
-                       ("chaos", "BENCH_CHAOS.json")):
+                       ("chaos", "BENCH_CHAOS.json"),
+                       ("fleet", "BENCH_FLEET.json")):
         path = root / name
         if path.exists():
             rows = bg.load_rows(str(path))
@@ -309,3 +310,63 @@ def test_canary_outage_visibility_rule_is_exact():
     blind = bg.compare(base, [
         {"scenario": "shard_kill", "canary_saw_outage": False}], "chaos")
     assert [c["ok"] for c in blind] == [False]
+
+
+def test_fleet_routed_overhead_and_token_identity_rules():
+    """The fleet row's two proof bits: routed overhead is an absolute
+    2% ceiling (baseline ignored), and token_identical is exact — a
+    router that changes the stream fails even if it got faster."""
+    base = [{"mode": "fleet_routed_vs_bare", "routed_overhead_pct": 0.3,
+             "token_identical": True}]
+    ok = bg.compare(base, [{
+        "mode": "fleet_routed_vs_bare", "routed_overhead_pct": 1.9,
+        "token_identical": True}], "fleet")
+    assert all(c["ok"] for c in ok)
+    bad = _checks_by_metric(bg.compare(base, [{
+        "mode": "fleet_routed_vs_bare", "routed_overhead_pct": 2.4,
+        "token_identical": False}], "fleet"))
+    assert not bad[("fleet_routed_vs_bare", "routed_overhead_pct")]["ok"]
+    assert not bad[("fleet_routed_vs_bare", "token_identical")]["ok"]
+
+
+def test_fleet_affinity_floor_is_absolute():
+    """affinity_hit_rate is an absolute floor (0.9): session follow-ups
+    re-prefilling elsewhere is wasted work regardless of what the
+    committed baseline happened to measure."""
+    base = [{"mode": "fleet_n3", "affinity_hit_rate": 1.0}]
+    assert all(c["ok"] for c in bg.compare(
+        base, [{"mode": "fleet_n3", "affinity_hit_rate": 0.95}], "fleet"))
+    low = bg.compare(
+        base, [{"mode": "fleet_n3", "affinity_hit_rate": 0.5}], "fleet")
+    assert [c["ok"] for c in low] == [False]
+
+
+def test_fleet_kill_rules_gate_outage_and_goodput_dip():
+    """The kill row's chaos gate: the fleet plane must have SEEN the
+    replica die (exact), the blackbox canary outage stays under its
+    ceiling, and the real-goodput dip stays above its floor."""
+    base = [{"mode": "fleet_kill", "fleet_saw_replica_outage": True,
+             "outage_canary_s": 0.0, "goodput_ratio_after_kill": 0.8}]
+    assert all(c["ok"] for c in bg.compare(base, [{
+        "mode": "fleet_kill", "fleet_saw_replica_outage": True,
+        "outage_canary_s": 4.0, "goodput_ratio_after_kill": 0.6}],
+        "fleet"))
+    by = _checks_by_metric(bg.compare(base, [{
+        "mode": "fleet_kill", "fleet_saw_replica_outage": False,
+        "outage_canary_s": 30.0, "goodput_ratio_after_kill": 0.2}],
+        "fleet"))
+    assert not by[("fleet_kill", "fleet_saw_replica_outage")]["ok"]
+    assert not by[("fleet_kill", "outage_canary_s")]["ok"]
+    assert not by[("fleet_kill", "goodput_ratio_after_kill")]["ok"]
+
+
+def test_fleet_autoscale_rules_are_exact():
+    """Both autoscaler proof bits are equal-rules: the seeded burst
+    must scale up, the post-cooldown quiet must scale down."""
+    base = [{"mode": "fleet_autoscale", "scaled_up_under_burst": True,
+             "scaled_down_after_cooldown": True}]
+    assert all(c["ok"] for c in bg.compare(base, [dict(base[0])], "fleet"))
+    stuck = _checks_by_metric(bg.compare(base, [{
+        "mode": "fleet_autoscale", "scaled_up_under_burst": False,
+        "scaled_down_after_cooldown": True}], "fleet"))
+    assert not stuck[("fleet_autoscale", "scaled_up_under_burst")]["ok"]

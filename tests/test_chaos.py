@@ -74,10 +74,23 @@ def test_elastic_requires_epoch_frequency():
                      frequency="batch", elastic=True)
 
 
+def _kill_plan():
+    """Kill w1 at its FIRST lease, with w0 stalled briefly at its own
+    first unit. Killing at the second lease (the original plan) only
+    fired when thread scheduling let w1 win a second lease before w0
+    drained the 6-unit ledger — ``should_kill`` records a trace entry
+    only when it FIRES, so on losing interleavings the death, the
+    requeue, and the plan digest all silently vanished. Seq 0 is
+    reached the moment w1 leases anything, and the stall holds w0 at
+    its own boundary long enough that w1 always gets that lease."""
+    return FaultPlan(seed=11, kill_worker_at={"w1": 0},
+                     stall_worker_at={"w0": 0}, stall_seconds=0.4)
+
+
 def test_kill_worker_exact_accounting_and_tolerant_loss(
         blobs_xy, baseline_loss):
     x, y = blobs_xy
-    plan = FaultPlan(seed=11, kill_worker_at={"w1": 1})
+    plan = _kill_plan()
     trainer = _trainer(fault_plan=plan)
     _, history = trainer.fit(ShardedDataset(x, y, PARTITIONS),
                              epochs=EPOCHS, batch_size=16)
@@ -97,11 +110,13 @@ def test_kill_worker_replays_byte_identically(blobs_xy):
     x, y = blobs_xy
     digests = []
     for _ in range(2):
-        plan = FaultPlan(seed=11, kill_worker_at={"w1": 1})
+        plan = _kill_plan()
         trainer = _trainer(fault_plan=plan)
         trainer.fit(ShardedDataset(x, y, PARTITIONS),
                     epochs=EPOCHS, batch_size=16)
-        assert trainer.elastic_stats["completed_units"] == UNITS
+        stats = trainer.elastic_stats
+        assert stats["completed_units"] == UNITS
+        assert [d["worker"] for d in stats["worker_deaths"]] == ["w1"]
         digests.append(plan.trace_digest())
     assert digests[0] == digests[1]
 
@@ -177,7 +192,7 @@ def test_traced_chaos_merged_digest_is_replay_stable(blobs_xy, tmp_path):
     for run in range(2):
         tracer = obs.enable_tracing(capacity=65536, annotate_device=False)
         try:
-            plan = FaultPlan(seed=11, kill_worker_at={"w1": 1})
+            plan = _kill_plan()
             trainer = _trainer(fault_plan=plan)
             trainer.fit(ShardedDataset(x, y, PARTITIONS),
                         epochs=EPOCHS, batch_size=16)

@@ -534,3 +534,48 @@ def test_fleet_top_renders_load_and_goodput_columns():
     assert "dead" in row_gone
     # Both new columns render '-' for the dead proc (no stale score).
     assert row_gone.split()[-3:-1] == ["-", "-"]
+
+
+# --------------------------------------------------------------------------
+# /replicas federation (serving-fleet router roster)
+# --------------------------------------------------------------------------
+
+
+def test_replicas_route_is_optional_per_process():
+    """A roster mixing a router (serves /replicas) with a bare proc
+    (404s it) still polls clean: the tolerant fetch keeps the bare proc
+    alive, and only the router contributes to snapshot()["replicas"]."""
+    roster_doc = {
+        "replicas": {"r0": {"state": "serving", "boot": 1}},
+        "router": {"requests": 7, "requeues": 1, "sessions": 2},
+        "autoscale": None,
+    }
+    bodies = {
+        "http://router": {**_fake_bodies(),
+                          "/replicas": json.dumps(roster_doc).encode()},
+        "http://bare": _fake_bodies(),  # no /replicas key → fetch raises
+    }
+    agg = FleetAggregator(clock=lambda: 0.0,
+                          fetch=_fake_fetch_factory(bodies))
+    agg.add("http://router", name="router")
+    agg.add("http://bare", name="bare")
+    tally = agg.poll(now=0.0)
+    assert tally == {"t": 0.0, "ok": 2, "failed": 0}
+    snap = agg.snapshot(now=0.0)
+    assert snap["status_counts"] == {"alive": 2}
+    assert set(snap["replicas"]) == {"router"}
+    assert snap["replicas"]["router"] == roster_doc
+
+
+def test_empty_replica_roster_is_not_federated():
+    """An engine that serves /replicas but fronts no fleet (the opsd
+    default doc) is excluded from the merged view — the key lists
+    routers, not every process that answers the route."""
+    empty = {"replicas": {}, "router": None, "autoscale": None}
+    bodies = {"http://eng": {**_fake_bodies(),
+                             "/replicas": json.dumps(empty).encode()}}
+    agg = FleetAggregator(clock=lambda: 0.0,
+                          fetch=_fake_fetch_factory(bodies))
+    agg.add("http://eng", name="eng")
+    agg.poll(now=0.0)
+    assert agg.snapshot(now=0.0)["replicas"] == {}

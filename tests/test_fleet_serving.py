@@ -1,0 +1,372 @@
+"""Replicated serving fleet: ReplicaSet lifecycle, the signal-driven
+router (session affinity, shed latch, requeue-across-death), the
+canary-flagged drain-and-restart loop, and the /replicas ops surface.
+
+The autoscaler's decision core has its own file
+(test_fleet_autoscaler.py); here it only appears where the router
+actuates it.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from elephas_tpu import obs
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.models import get_model
+from elephas_tpu.obs.flight import FlightRecorder
+from elephas_tpu.serving import (
+    FleetUnavailable,
+    InferenceEngine,
+    QueueFull,
+    ReplicaDead,
+    ReplicaSet,
+    Router,
+)
+
+VOCAB, SEQ = 97, 64
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return CompiledModel(
+        get_model(
+            "transformer_lm", vocab_size=VOCAB, d_model=32, num_heads=4,
+            num_layers=2, max_seq_len=SEQ,
+        ),
+        optimizer={"name": "adam", "learning_rate": 3e-3},
+        loss="sparse_categorical_crossentropy",
+        metrics=[],
+        input_shape=(SEQ,),
+        input_dtype=jnp.int32,
+        seed=0,
+    )
+
+
+def _factory(compiled, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("max_len", 24)
+    kw.setdefault("queue_depth", 16)
+
+    def factory():
+        return InferenceEngine(compiled, **kw)
+
+    return factory
+
+
+@pytest.fixture()
+def flight():
+    """Fresh global flight ring per test — fleet lifecycle events must
+    be assertable without bleed-through from earlier tests."""
+    previous = obs.default_flight_recorder()
+    recorder = FlightRecorder(capacity=256)
+    obs.set_default_flight_recorder(recorder)
+    try:
+        yield recorder
+    finally:
+        obs.set_default_flight_recorder(previous)
+
+
+@pytest.fixture()
+def fleet(compiled, flight):
+    """(replica_set, router) with guaranteed teardown."""
+    made = []
+
+    def make(n=2, mount_ops=False, **router_kw):
+        rs = ReplicaSet(_factory(compiled), initial=n, mount_ops=mount_ops)
+        router = Router(rs, **router_kw)
+        made.append(router)
+        return rs, router
+
+    try:
+        yield make
+    finally:
+        for router in made:
+            router.close()
+
+
+class _Bad:
+    """A ledger sample that busts every latency objective."""
+    status, ttft_s, itl_s_avg = "completed", 9.0, 0.9
+
+
+# -- the router/engine contract -------------------------------------------
+
+
+def test_single_replica_routed_is_token_identical_to_bare(compiled, fleet):
+    """The ISSUE's correctness proof: one replica behind the router
+    serves the same token streams as a bare engine — the router adds a
+    hop, never a different computation."""
+    prompts = [[5, 3, 9], [7, 2, 8, 4, 1, 6], [11, 12], [1, 2, 3, 4]]
+    bare = _factory(compiled)()
+    ref = []
+    for p in prompts:
+        rid = bare.submit(p, max_new_tokens=6)
+        ref.append(bare.result(rid, timeout_s=30).tokens)
+
+    _, router = fleet(n=1)
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    out = [router.result(r, timeout_s=30).tokens for r in rids]
+    assert out == ref
+
+
+def test_unknown_router_id_and_no_serving_replicas(compiled, fleet):
+    rs, router = fleet(n=1)
+    with pytest.raises(KeyError):
+        router.result(999)
+    rs.kill("r0")
+    with pytest.raises(FleetUnavailable):
+        router.submit([1, 2], max_new_tokens=2)
+
+
+def test_queue_full_propagates_when_every_replica_rejects(compiled):
+    """Admission control stays end-to-end: when all replicas' queues
+    are full the router surfaces the engine's QueueFull (with its
+    retry hint), not a synthetic error."""
+    rs = ReplicaSet(_factory(compiled, queue_depth=1), initial=1)
+    router = Router(rs)
+    try:
+        rs.get("r0").engine.halt()  # freeze: queue can only fill
+        router.submit([1, 2], max_new_tokens=2)
+        with pytest.raises(QueueFull):
+            for _ in range(4):
+                router.submit([1, 2], max_new_tokens=2)
+    finally:
+        router.close()
+
+
+# -- session affinity ------------------------------------------------------
+
+
+def test_session_affinity_hits_then_misses_on_dead_pin(compiled, fleet):
+    """Turn 2 of a session lands on the pinned replica (hit); after
+    that replica dies between turns, the next turn explicitly misses,
+    re-routes, and re-pins."""
+    rs, router = fleet(n=2)
+    miss_counter = obs.default_registry().counter("affinity_miss_total")
+    hit_counter = obs.default_registry().counter("affinity_hit_total")
+    miss0, hit0 = miss_counter.value, hit_counter.value
+
+    router.result(router.submit([5, 3], max_new_tokens=2, session="s0"),
+                  timeout_s=30)
+    pin = router.session_replica("s0")
+    router.result(router.submit([5, 3, 1], max_new_tokens=2, session="s0"),
+                  timeout_s=30)
+    assert router.affinity_hits == 1
+    assert hit_counter.value - hit0 == 1
+
+    rs.kill(pin)
+    res = router.result(
+        router.submit([5, 3, 1, 2], max_new_tokens=2, session="s0"),
+        timeout_s=30)
+    assert res.status == "completed"
+    assert router.affinity_misses == 1
+    assert miss_counter.value - miss0 == 1
+    new_pin = router.session_replica("s0")
+    assert new_pin is not None and new_pin != pin
+
+
+def test_shedding_replica_loses_its_affinity_pin(compiled, fleet):
+    """A latched goodput_burn alert breaks affinity too: keeping a
+    session on a replica that is burning budget defeats the latch."""
+    rs, router = fleet(n=2)
+    router.result(router.submit([5, 3], max_new_tokens=2, session="s0"),
+                  timeout_s=30)
+    pin = router.session_replica("s0")
+    for _ in range(6):
+        rs.get(pin).engine.slo.record(_Bad())
+    router.tick()
+    assert rs.get(pin).shedding
+    router.result(router.submit([5, 3, 1], max_new_tokens=2, session="s0"),
+                  timeout_s=30)
+    assert router.affinity_misses == 1
+    assert router.session_replica("s0") != pin
+
+
+# -- shed latch in dispatch ------------------------------------------------
+
+
+def test_dispatch_avoids_shedding_replica(compiled, fleet):
+    """New work ranks every clean replica ahead of a latched-burn one;
+    the shed replica takes nothing while a clean one exists."""
+    rs, router = fleet(n=2)
+    for _ in range(6):
+        rs.get("r0").engine.slo.record(_Bad())
+    router.tick()
+    assert rs.get("r0").shedding and not rs.get("r1").shedding
+    rids = [router.submit([1, 2], max_new_tokens=2) for _ in range(3)]
+    doc = router.replicas_doc()["replicas"]
+    assert doc["r0"]["in_flight"] == 0
+    assert doc["r1"]["in_flight"] == 3
+    for r in rids:
+        assert router.result(r, timeout_s=30).status == "completed"
+
+
+def test_all_shedding_still_serves(compiled, fleet):
+    """Shedding is a preference, not an outage: when every replica is
+    latched, traffic still flows (degraded beats down)."""
+    rs, router = fleet(n=2)
+    for rid in ("r0", "r1"):
+        for _ in range(6):
+            rs.get(rid).engine.slo.record(_Bad())
+    router.tick()
+    assert all(r.shedding for r in rs.serving())
+    res = router.result(router.submit([1, 2], max_new_tokens=2),
+                        timeout_s=30)
+    assert res.status == "completed"
+
+
+# -- lifecycle: drain / kill / restart ------------------------------------
+
+
+def test_drain_completes_in_flight_then_goes_dead_drained(
+        compiled, fleet, flight):
+    rs, router = fleet(n=2)
+    rid = router.submit([5, 3, 9], max_new_tokens=8, session="s0")
+    victim = router.session_replica("s0")
+    rs.drain(victim)
+    assert rs.get(victim).state == "draining"
+    # Draining replicas take no new work...
+    rid2 = router.submit([1, 2], max_new_tokens=2)
+    assert router.result(rid2, timeout_s=30).status == "completed"
+    # ...but finish and hand out what they hold.
+    assert router.result(rid, timeout_s=30).status == "completed"
+    deadline = time.monotonic() + 10
+    while rs.get(victim).state != "dead" and time.monotonic() < deadline:
+        router.tick()
+        time.sleep(0.01)
+    assert rs.get(victim).state == "dead" and rs.get(victim).drained
+    kinds = [e.kind for e in flight.events()]
+    assert "replica_drain" in kinds
+
+
+def test_kill_mid_flight_requeues_and_completes(compiled, fleet):
+    """The recovery proof: requests in flight on a killed replica
+    surface as ReplicaDead internally and complete on a survivor —
+    the client sees slower results, never the death."""
+    rs, router = fleet(n=2)
+    # Pin a session so the kill provably lands under live requests.
+    router.result(router.submit([1, 2], max_new_tokens=2, session="s0"),
+                  timeout_s=30)
+    victim = router.session_replica("s0")
+    rids = [router.submit([5, 3, 9], max_new_tokens=12, session="s0")
+            for _ in range(3)]
+    rs.kill(victim)
+    results = [router.result(r, timeout_s=60) for r in rids]
+    assert all(r.status == "completed" for r in results)
+    assert router.requeues >= 3
+    rep = rs.get(victim)
+    assert rep.state == "dead" and not rep.drained
+    # The requeue re-pinned the session onto the survivor.
+    assert router.session_replica("s0") != victim
+
+
+def test_replica_dead_surfaces_when_no_survivor(compiled, fleet):
+    rs, router = fleet(n=1)
+    rid = router.submit([5, 3], max_new_tokens=12)
+    rs.kill("r0")
+    with pytest.raises((ReplicaDead, FleetUnavailable)):
+        router.result(rid, timeout_s=10)
+
+
+def test_restart_is_same_slot_new_boot_fresh_engine(
+        compiled, fleet, flight):
+    rs, router = fleet(n=1)
+    old_engine = rs.get("r0").engine
+    rs.kill("r0")
+    rs.restart("r0")
+    rep = rs.get("r0")
+    assert rep.state == "serving" and rep.boot == 2
+    assert rep.engine is not old_engine
+    res = router.result(router.submit([1, 2], max_new_tokens=2),
+                        timeout_s=30)
+    assert res.status == "completed"
+    kinds = [e.kind for e in flight.events()]
+    assert "replica_restart" in kinds
+
+
+# -- canary-flagged drain-and-restart -------------------------------------
+
+
+def test_canary_failure_drains_and_restarts_replica(
+        compiled, fleet, flight):
+    """tick() actuates on blackbox evidence: a replica whose canary
+    failed gets drained (finishing its work) and restarted with a
+    fresh engine, narrated as replica_drain + replica_restart."""
+    rs, router = fleet(n=2)
+    rep = rs.get("r1")
+    rep.canary.failures += 1  # simulate a failed blackbox probe
+    acts = router.tick()
+    assert "r1" in acts["canary_drained"]
+    assert rep.state == "draining" and rep.pending_restart
+    deadline = time.monotonic() + 10
+    restarted = False
+    while time.monotonic() < deadline:
+        acts = router.tick()
+        if "r1" in acts["restarted"]:
+            restarted = True
+            break
+        time.sleep(0.01)
+    assert restarted and rep.state == "serving" and rep.boot == 2
+    reasons = [e.detail.get("reason") for e in flight.events()]
+    assert "canary_failures" in reasons and "canary" in reasons
+    # The failure was consumed: the next tick must not re-drain.
+    acts = router.tick()
+    assert acts["canary_drained"] == []
+
+
+def test_tick_probe_runs_blackbox_canaries(compiled, fleet):
+    rs, router = fleet(n=2)
+    before = [r.canary.probes for r in rs.serving()]
+    router.tick(probe=True)
+    after = [r.canary.probes for r in rs.serving()]
+    assert all(b + 1 == a for b, a in zip(before, after))
+
+
+# -- /replicas ops surface -------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_router_ops_serves_replicas_doc(compiled, fleet):
+    rs, router = fleet(n=2)
+    router.mount_ops(port=0)
+    base = f"http://127.0.0.1:{router.ops.port}"
+    doc = _get_json(f"{base}/replicas")
+    assert set(doc["replicas"]) == {"r0", "r1"}
+    card = doc["replicas"]["r0"]
+    assert card["state"] == "serving" and card["boot"] == 1
+    for key in ("load_score", "queue_depth", "burn_worst", "shedding",
+                "in_flight", "affinity"):
+        assert key in card
+    assert doc["router"]["requests"] == 0
+    health = _get_json(f"{base}/healthz")
+    assert health["serving"] == 2 and health["healthy"]
+    router.unmount_ops()
+
+
+def test_replicas_doc_marks_dead_replica_signals_none(compiled, fleet):
+    rs, router = fleet(n=2)
+    rs.kill("r0")
+    card = router.replicas_doc()["replicas"]["r0"]
+    assert card["state"] == "dead"
+    assert card["load_score"] is None and card["burn_worst"] is None
+
+
+def test_router_goodput_ledger_is_router_relative(compiled, fleet):
+    """The router's own ledger records completed results (canaries
+    excluded) with TTFT measured from the router submit."""
+    rs, router = fleet(n=1)
+    router.result(router.submit([1, 2], max_new_tokens=2), timeout_s=30)
+    router.result(router.submit([1, 2], max_new_tokens=2, canary=True),
+                  timeout_s=30)
+    snap = router.slo.snapshot()
+    assert snap["evaluated"] == 1  # the canary stayed out

@@ -400,3 +400,31 @@ def test_route_vocab_load_fails_loudly_without_table(tmp_path):
     (tmp_path / "obs" / "opsd.py").write_text("SOMETHING_ELSE = 1\n")
     with pytest.raises(RuntimeError, match="ROUTES"):
         lint.load_route_vocab(tmp_path)
+
+
+# -- fleet additions to the vocabularies -------------------------------------
+
+
+def test_fleet_vocab_entries_are_registered():
+    """The fleet plane's three actuation kinds and the router's ops
+    route are in the registered tables — so fleet code narrating a
+    drain/restart/scale, or mounting /replicas, passes the kind and
+    route lints instead of needing pragmas."""
+    pkg_root = _pkg_root()
+    kinds, _ = lint.load_registered_vocab(pkg_root)
+    assert {"replica_drain", "replica_restart", "fleet_scale"} <= set(kinds)
+    assert "/replicas" in lint.load_route_vocab(pkg_root)
+
+
+def test_lint_package_recurses_into_subpackages(tmp_path):
+    """``lint_package`` walks subdirectories, so serving/fleet/ inherits
+    the blocking-conversion ban — a violation one level down is caught,
+    not silently skipped."""
+    pkg = tmp_path / "serving"
+    sub = pkg / "fleet"
+    sub.mkdir(parents=True)
+    (pkg / "top.py").write_text("def f(x):\n    return x\n")
+    (sub / "deep.py").write_text("def f(x):\n    return int(x)\n")
+    violations = lint.lint_package(pkg)
+    assert len(violations) == 1
+    assert violations[0].path.endswith("deep.py")

@@ -531,3 +531,28 @@ def test_load_and_slo_routes_serve_wired_documents():
         assert doc["goodput"]["lifetime"]["ttft"] == 1.0
     finally:
         server.stop()
+
+
+def test_replicas_route_default_empty(ops):
+    """An ops endpoint without a fleet behind it still serves the
+    /replicas shape — empty roster, no router, no autoscaler — so
+    scrapers can poll every process uniformly."""
+    status, doc = _get_json(f"{ops.url}/replicas")
+    assert status == 200
+    assert doc == {"replicas": {}, "router": None, "autoscale": None}
+
+
+def test_replicas_route_serves_replicas_fn():
+    doc_out = {
+        "replicas": {"r0": {"state": "serving", "boot": 1}},
+        "router": {"requests": 4, "requeues": 0},
+        "autoscale": None,
+    }
+    server = OpsServer(port=0, registry=MetricsRegistry(),
+                       replicas_fn=lambda: doc_out).start()
+    try:
+        status, doc = _get_json(f"{server.url}/replicas")
+        assert status == 200
+        assert doc == doc_out
+    finally:
+        server.stop()
