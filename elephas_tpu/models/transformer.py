@@ -443,6 +443,38 @@ def make_decode_cache(decode_module, batch: int, total_len: int):
     )
 
 
+def make_paged_decode_cache(decode_module, max_slots: int, num_blocks: int,
+                            block_size: int):
+    """Zeroed PAGED decode cache: the same pytree structure as
+    ``make_decode_cache`` but with every K/V leaf laid out as physical
+    blocks ``(num_blocks, heads, block_size, head_dim)`` instead of one
+    contiguous ``(max_slots, heads, max_len, head_dim)`` row per slot.
+    A host-side block table maps ``slot -> block ids``; slots share
+    blocks by holding the same id (reference-counted by the pool).
+
+    Index leaves (``cache_index``/``pos_index``) stay per-SLOT
+    ``(max_slots,)`` vectors — positions are a property of the logical
+    sequence, not of physical block placement — so the same flax apply
+    drives both layouts once the blocks are gathered contiguous."""
+    cache_shapes = jax.eval_shape(
+        lambda: decode_module.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32)
+        )
+    )["cache"]
+
+    def build(path, s):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("cached_key", "cached_value"):
+            _, heads, _, head_dim = s.shape
+            return jnp.zeros((num_blocks, heads, block_size, head_dim),
+                             s.dtype)
+        if name in ("cache_index", "pos_index"):
+            return jnp.zeros((max_slots,), jnp.int32)
+        return jnp.zeros(s.shape, s.dtype)
+
+    return jax.tree_util.tree_map_with_path(build, cache_shapes)
+
+
 def generate(
     compiled,
     prompt,

@@ -68,6 +68,9 @@ KINDS = (
     "replica_drain",
     "replica_restart",
     "fleet_scale",
+    # paged KV pool (serving/kv_pool.py): a resident prefix-cache entry
+    # was LRU-evicted to free blocks under allocation pressure
+    "prefix_evict",
 )
 
 

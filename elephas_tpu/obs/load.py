@@ -66,11 +66,13 @@ class LoadSnapshot:
     __slots__ = (
         "t", "queue_depth", "queue_limit", "active", "max_slots",
         "kv_free_frac", "admit_rate", "reject_rate", "tokens_per_s",
+        "kv_blocks_free", "kv_blocks_total", "prefix_hit_rate",
     )
 
     def __init__(self, *, t, queue_depth, queue_limit, active, max_slots,
                  kv_free_frac, admit_rate=0.0, reject_rate=0.0,
-                 tokens_per_s=0.0):
+                 tokens_per_s=0.0, kv_blocks_free=None,
+                 kv_blocks_total=None, prefix_hit_rate=None):
         self.t = float(t)
         self.queue_depth = int(queue_depth)
         self.queue_limit = max(1, int(queue_limit))
@@ -80,6 +82,19 @@ class LoadSnapshot:
         self.admit_rate = max(0.0, float(admit_rate))
         self.reject_rate = max(0.0, float(reject_rate))
         self.tokens_per_s = max(0.0, float(tokens_per_s))
+        # Paged-pool extras (None on contiguous pools): block-granular
+        # KV pressure — ``kv_free_frac`` above is already block-derived
+        # when these are present — plus the prefix-cache hit rate a
+        # router can prefer replicas on.
+        self.kv_blocks_free = (
+            None if kv_blocks_free is None else int(kv_blocks_free)
+        )
+        self.kv_blocks_total = (
+            None if kv_blocks_total is None else int(kv_blocks_total)
+        )
+        self.prefix_hit_rate = (
+            None if prefix_hit_rate is None else float(prefix_hit_rate)
+        )
 
     @property
     def occupancy(self) -> float:
@@ -90,7 +105,7 @@ class LoadSnapshot:
         return min(1.0, self.queue_depth / self.queue_limit)
 
     def to_dict(self) -> Dict[str, float]:
-        return {
+        out = {
             "t": self.t,
             "queue_depth": self.queue_depth,
             "queue_limit": self.queue_limit,
@@ -103,6 +118,11 @@ class LoadSnapshot:
             "reject_rate_per_s": self.reject_rate,
             "tokens_per_s": self.tokens_per_s,
         }
+        if self.kv_blocks_total is not None:
+            out["kv_blocks_free"] = self.kv_blocks_free
+            out["kv_blocks_total"] = self.kv_blocks_total
+            out["prefix_hit_rate"] = self.prefix_hit_rate
+        return out
 
 
 def instant_load(snap: LoadSnapshot) -> float:
@@ -199,7 +219,8 @@ class LoadTracker:
 
     def observe(self, *, queue_depth, queue_limit, active, max_slots,
                 kv_free_frac, admitted_total=0, rejected_total=0,
-                tokens_total=0, now=None) -> LoadSnapshot:
+                tokens_total=0, now=None, kv_blocks_free=None,
+                kv_blocks_total=None, prefix_hit_rate=None) -> LoadSnapshot:
         now = self.clock() if now is None else float(now)
         with self._lock:
             self._admitted.push(now, float(admitted_total))
@@ -212,6 +233,9 @@ class LoadTracker:
                 admit_rate=self._admitted.rate(w, now=now) or 0.0,
                 reject_rate=self._rejected.rate(w, now=now) or 0.0,
                 tokens_per_s=self._tokens.rate(w, now=now) or 0.0,
+                kv_blocks_free=kv_blocks_free,
+                kv_blocks_total=kv_blocks_total,
+                prefix_hit_rate=prefix_hit_rate,
             )
             self._raw = instant_load(snap)
             score = self.score.update(self._raw, now)

@@ -107,6 +107,80 @@ def cache_attention_mask(max_len, seq, idx, pad_offset=None):
     return valid[:, None]  # broadcast over heads
 
 
+# -- paged KV-cache gather/scatter ------------------------------------------
+#
+# The serving pool's paged layout stores K/V as physical blocks
+# (num_blocks, heads, block_size, head_dim) shared across slots through a
+# (max_slots, blocks_per_slot) block table. These helpers are the bridge
+# between that layout and the contiguous (slots, heads, len, head_dim)
+# view the dense cache-attention path consumes: gather through the table
+# before the apply, scatter exactly the freshly-written columns back
+# after it. Unallocated table entries carry the OUT-OF-RANGE id
+# ``num_blocks``: gathers clamp (the garbage columns sit at or past
+# every reader's cache index, so the causal mask hides them) and
+# scatters drop (``mode="drop"``), so no index is ever negative.
+
+
+def paged_to_contiguous(leaf, table):
+    """Gather a paged K/V leaf into per-slot contiguous rows.
+
+    ``leaf``: (num_blocks, heads, block_size, head_dim) physical blocks;
+    ``table``: (max_slots, blocks_per_slot) int32 block ids. Returns
+    (max_slots, heads, blocks_per_slot * block_size, head_dim).
+    """
+    slots, bps = table.shape
+    _, heads, bs, head_dim = leaf.shape
+    gathered = leaf[table]  # (slots, bps, heads, bs, head_dim); OOB clamps
+    gathered = jnp.transpose(gathered, (0, 2, 1, 3, 4))
+    return gathered.reshape(slots, heads, bps * bs, head_dim)
+
+
+def slot_row_to_contiguous(leaf, row_table):
+    """Gather ONE slot's blocks as a batch-1 contiguous cache row.
+
+    ``row_table``: (blocks_per_slot,) int32 block ids for the slot.
+    Returns (1, heads, blocks_per_slot * block_size, head_dim).
+    """
+    gathered = leaf[row_table]  # (bps, heads, bs, head_dim)
+    gathered = jnp.transpose(gathered, (1, 0, 2, 3))
+    heads, bps, bs, head_dim = gathered.shape
+    return gathered.reshape(heads, bps * bs, head_dim)[None]
+
+
+def scatter_decode_columns(pool_leaf, contiguous, table, idx, active):
+    """Write each slot's just-decoded column back into its physical block.
+
+    ``contiguous`` is the (max_slots, heads, L, head_dim) view AFTER the
+    apply wrote column ``idx[s]`` for every slot s (``idx`` is the
+    PRE-advance cache index vector). Inactive lanes scatter to the
+    out-of-range block id and drop — their computed column is garbage by
+    contract.
+    """
+    num_blocks, _, bs, _ = pool_leaf.shape
+    written = jnp.take_along_axis(
+        contiguous, idx[:, None, None, None], axis=2
+    )[:, :, 0, :]  # (max_slots, heads, head_dim)
+    blk = jnp.take_along_axis(table, (idx // bs)[:, None], axis=1)[:, 0]
+    target = jnp.where(active, blk, num_blocks)
+    return pool_leaf.at[target, :, idx % bs].set(written, mode="drop")
+
+
+def scatter_prefill_columns(pool_leaf, row_table, start, chunk):
+    """Write one prefill chunk's columns ``[start, start + C)`` of ONE
+    slot into its physical blocks.
+
+    ``chunk``: (heads, C, head_dim) — the freshly-computed K or V
+    columns. Columns landing in unallocated blocks (right-pad garbage
+    past the slot's allocation) hit the out-of-range id and drop.
+    """
+    bs = pool_leaf.shape[2]
+    cols = start + jnp.arange(chunk.shape[1])
+    target = row_table[cols // bs]
+    return pool_leaf.at[target, :, cols % bs].set(
+        jnp.transpose(chunk, (1, 0, 2)), mode="drop"
+    )
+
+
 def pallas_min_seq(head_dim: int) -> int:
     """Sequence length above which the Pallas kernels beat the XLA
     blockwise path, as a function of head_dim (VERDICT r4 #7 — the r4

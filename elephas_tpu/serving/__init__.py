@@ -9,6 +9,13 @@ decode step into an engine that serves request traffic:
 - ``KVCachePool``      — a fixed-shape slot pool of per-layer KV caches;
                          admission/eviction never reshapes the compiled
                          decode program (``serving.kv_pool``),
+- ``PagedKVPool``      — its block/paged successor (the default):
+                         reference-counted fixed-size KV blocks behind a
+                         ``BlockTable``, a ``PrefixCache`` that admits
+                         resident prompt prefixes by refcount instead of
+                         re-prefilling, LRU prefix eviction under
+                         pressure, copy-on-write at shared boundaries
+                         (``serving.kv_pool``),
 - ``ContinuousBatchingScheduler`` — bounded request queue, prefill/decode
                          interleaving, deadline eviction, backpressure
                          (``serving.scheduler``),
@@ -41,8 +48,11 @@ unpipelined path (``pipeline=False``).
 
 from elephas_tpu.serving import host_sync  # noqa: F401
 from elephas_tpu.serving.kv_pool import (  # noqa: F401
+    BlockTable,
     DonatedBufferError,
     KVCachePool,
+    PagedKVPool,
+    PrefixCache,
 )
 from elephas_tpu.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,

@@ -61,7 +61,7 @@ import jax
 import jax.numpy as jnp
 
 from elephas_tpu import obs
-from elephas_tpu.serving.kv_pool import KVCachePool
+from elephas_tpu.serving.kv_pool import KVCachePool, PagedKVPool
 from elephas_tpu.serving.metrics import ServingMetrics
 from elephas_tpu.serving.scheduler import (
     ContinuousBatchingScheduler,
@@ -95,6 +95,32 @@ class InferenceEngine:
     pipeline: one-step-lookahead decode (default). ``False`` selects the
         unpipelined oracle path — token-identical, device idles during
         host bookkeeping; exists for A/B tests and benchmarks.
+    paged: block/paged KV pool (default). The pool stores fixed-size KV
+        blocks behind a reference-counted block table with a prefix
+        cache, prompts are never left-padded (shared prefixes must land
+        at identical columns), and prefill runs through the CHUNKED
+        program — still exactly one prefill + one decode compile,
+        token-identical to ``paged=False``. ``False`` selects the
+        contiguous per-slot layout (the oracle the paged path is tested
+        against).
+    kv_block_size: columns per physical KV block (paged only; default
+        ``max_prompt_len``). Smaller blocks share finer-grained
+        prefixes at the cost of a wider block table.
+    kv_blocks: physical block count (paged only; default
+        ``max_slots * ceil(max_len / kv_block_size)`` — always enough
+        for every slot, so prefix eviction can never dead-end).
+    prefix_cache: keep released/committed prompt chains resident so
+        later prompts sharing a full-block prefix admit by refcount
+        instead of re-prefilling (paged only; default True).
+    prefill_chunk: prefill chunk width (paged only; default
+        ``max_prompt_len`` = one-shot). Smaller chunks split long
+        prompts into several compiled-program calls so decode steps can
+        interleave between them.
+    prefill_chunks_per_step: max prefill chunks dispatched per scheduler
+        step (paged only; default None = run every pending chunk at
+        admission). Set to a small int to bound how long any one step's
+        prefill work can stall in-flight decodes — the ITL-p99
+        protection the chunked program exists for.
     sink: optional ``metrics.JsonlSink`` for request/step records.
     tracer: optional ``obs.Tracer`` recording the per-request span tree
         (submit→queue→admit→prefill→decode→finish, one ``req:<id>``
@@ -119,6 +145,12 @@ class InferenceEngine:
         top_k: int = 0,
         seed: int = 0,
         pipeline: bool = True,
+        paged: bool = True,
+        kv_block_size: Optional[int] = None,
+        kv_blocks: Optional[int] = None,
+        prefix_cache: bool = True,
+        prefill_chunk: Optional[int] = None,
+        prefill_chunks_per_step: Optional[int] = None,
         sink=None,
         clock=time.monotonic,
         tracer=None,
@@ -153,7 +185,37 @@ class InferenceEngine:
         self._greedy = temperature == 0.0
 
         self.tracer = tracer if tracer is not None else obs.default_tracer()
-        self.pool = KVCachePool(self.decode_module, max_slots, max_len)
+        self.paged = paged
+        if paged:
+            chunk = (prefill_chunk if prefill_chunk is not None
+                     else max_prompt_len)
+            if not 1 <= chunk <= max_prompt_len:
+                raise ValueError(
+                    f"prefill_chunk ({chunk}) must be in "
+                    f"[1, max_prompt_len={max_prompt_len}]"
+                )
+            self.prefill_chunk = chunk
+            self.pool = PagedKVPool(
+                self.decode_module, max_slots, max_len,
+                block_size=(kv_block_size if kv_block_size is not None
+                            else max_prompt_len),
+                num_blocks=kv_blocks,
+                prefix_cache=prefix_cache,
+                # A chunk may start as late as the last prompt column;
+                # its compiled slice/scatter window must fit the virtual
+                # row without clamping.
+                virtual_len=max_prompt_len - 1 + chunk,
+            )
+        else:
+            if (kv_block_size is not None or kv_blocks is not None
+                    or prefill_chunk is not None
+                    or prefill_chunks_per_step is not None):
+                raise ValueError(
+                    "kv_block_size/kv_blocks/prefill_chunk/"
+                    "prefill_chunks_per_step require paged=True"
+                )
+            self.prefill_chunk = None
+            self.pool = KVCachePool(self.decode_module, max_slots, max_len)
         self.queue = RequestQueue(max_depth=queue_depth)
         self.metrics = ServingMetrics(sink=sink, clock=clock)
         # Saturation + goodput plane, both on the engine's clock: the
@@ -174,6 +236,9 @@ class InferenceEngine:
             pipeline=pipeline,
             tracer=self.tracer,
             load=self.load,
+            chunk_prefill_fn=self._chunk_prefill if paged else None,
+            prefill_chunk=self.prefill_chunk,
+            prefill_chunks_per_step=prefill_chunks_per_step,
         )
 
         self._prefill_traces = 0
@@ -202,6 +267,19 @@ class InferenceEngine:
         if in_shardings is not None:
             pre_in, dec_in = in_shardings
             pre_out, dec_out = out_shardings
+        if self.paged:
+            # BOTH paged programs rewrite the pool, so both donate it
+            # (argnum 1); chunk prefill scatters its columns in place
+            # exactly like decode does.
+            self._jit_prefill = jax.jit(
+                self._chunk_prefill_impl, donate_argnums=(1,),
+                in_shardings=pre_in, out_shardings=pre_out,
+            )
+            self._jit_decode = jax.jit(
+                self._paged_decode_impl, donate_argnums=(1,),
+                in_shardings=dec_in, out_shardings=dec_out,
+            )
+            return
         self._jit_prefill = jax.jit(
             self._prefill_impl, in_shardings=pre_in, out_shardings=pre_out
         )
@@ -267,6 +345,132 @@ class InferenceEngine:
         )
         return nxt, mutated["cache"]
 
+    @staticmethod
+    def _leaf_name(path) -> str:
+        return path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+
+    def _chunk_prefill_impl(self, params, cache, table, tokens, slot,
+                            start, valid, rng):
+        """One prompt CHUNK for one slot, through the paged pool: gather
+        the slot's blocks contiguous, run the same dense cache-attention
+        apply the contiguous prefill uses (positions/causality from the
+        cache index — token identity by construction), scatter exactly
+        the chunk's columns back, and advance the slot's index vectors
+        to ``start + valid``.
+
+        ``tokens`` is (1, chunk) with the final chunk RIGHT-padded;
+        padded columns compute garbage K/V that lands at-or-past the
+        slot's cache index, stays causally invisible, and is overwritten
+        by subsequent decode steps. ``slot``/``start``/``valid`` are
+        traced — one compile covers every slot, chunk position, and
+        ragged tail."""
+        self._prefill_traces += 1
+        from elephas_tpu.utils.compiler import note_retrace
+
+        note_retrace("serving_prefill", count=self._prefill_traces)
+        from elephas_tpu.models.transformer import sample_tokens
+        from elephas_tpu.ops.attention import (
+            scatter_prefill_columns,
+            slot_row_to_contiguous,
+        )
+
+        chunk_width = tokens.shape[1]
+        row = jax.lax.dynamic_index_in_dim(table, slot, axis=0,
+                                           keepdims=False)
+
+        def to_row(path, leaf):
+            name = self._leaf_name(path)
+            if name in ("cached_key", "cached_value"):
+                return slot_row_to_contiguous(leaf, row)
+            if name in ("cache_index", "pos_index"):
+                return jnp.full((1,), start, jnp.int32)
+            return leaf
+
+        row_cache = jax.tree_util.tree_map_with_path(to_row, cache)
+        logits, mutated = self.decode_module.apply(
+            {"params": params, "cache": row_cache},
+            tokens,
+            mutable=["cache"],
+        )
+        # The chunk's LAST VALID position predicts the first new token
+        # (only the final chunk's sample is ever read).
+        last = jax.lax.dynamic_slice_in_dim(logits, valid - 1, 1,
+                                            axis=1)[:, 0]
+        first = sample_tokens(
+            last, rng, self._greedy, self.top_k, self.temperature
+        )
+
+        def back(path, pool_leaf, mut_leaf):
+            name = self._leaf_name(path)
+            if name in ("cached_key", "cached_value"):
+                written = jax.lax.dynamic_slice_in_dim(
+                    mut_leaf[0], start, chunk_width, axis=1
+                )
+                return scatter_prefill_columns(pool_leaf, row, start,
+                                               written)
+            # Index vectors: this slot advances to its true prefilled
+            # depth (NOT start + chunk — the right-pad tail is garbage);
+            # every other slot's entry is untouched.
+            return pool_leaf.at[slot].set(start + valid)
+
+        new_cache = jax.tree_util.tree_map_with_path(
+            back, cache, mutated["cache"]
+        )
+        return first[0], new_cache
+
+    def _paged_decode_impl(self, params, cache, table, prev_tokens,
+                           override_vals, override_mask, active_mask,
+                           pad, rng):
+        """One decode step over every slot, through the paged pool:
+        gather all slots' blocks contiguous, run the UNCHANGED decode
+        apply, scatter back only the column each active lane wrote.
+        Gathered garbage from unallocated/clamped blocks sits past every
+        lane's cache index and never survives the causal mask."""
+        self._decode_traces += 1
+        from elephas_tpu.utils.compiler import note_retrace
+
+        note_retrace("serving_decode", count=self._decode_traces)
+        from elephas_tpu.models.transformer import sample_tokens
+        from elephas_tpu.ops.attention import (
+            paged_to_contiguous,
+            scatter_decode_columns,
+        )
+
+        # Pre-advance write column per lane (every layer advances in
+        # lockstep, so the first index leaf speaks for all).
+        flat = jax.tree_util.tree_flatten_with_path(cache)[0]
+        idx = next(leaf for path, leaf in flat
+                   if self._leaf_name(path) == "cache_index")
+
+        def to_contig(path, leaf):
+            if self._leaf_name(path) in ("cached_key", "cached_value"):
+                return paged_to_contiguous(leaf, table)
+            return leaf
+
+        contig = jax.tree_util.tree_map_with_path(to_contig, cache)
+        tokens = jnp.where(override_mask, override_vals, prev_tokens)
+        logits, mutated = self.decode_module.apply(
+            {"params": params, "cache": contig},
+            tokens[:, None],
+            pad_offset=pad,
+            active=active_mask,
+            mutable=["cache"],
+        )
+        nxt = sample_tokens(
+            logits[:, -1], rng, self._greedy, self.top_k, self.temperature
+        )
+
+        def back(path, pool_leaf, mut_leaf):
+            if self._leaf_name(path) in ("cached_key", "cached_value"):
+                return scatter_decode_columns(pool_leaf, mut_leaf, table,
+                                              idx, active_mask)
+            return mut_leaf  # index vectors: advanced for active lanes
+
+        new_cache = jax.tree_util.tree_map_with_path(
+            back, cache, mutated["cache"]
+        )
+        return nxt, new_cache
+
     def _next_rng(self):
         if self._greedy:
             return self._rng  # unused by greedy sampling; keep it constant
@@ -274,17 +478,40 @@ class InferenceEngine:
         return sub
 
     def _prefill(self, prompt, pad_offset):
+        if self.paged:
+            raise RuntimeError(
+                "paged engines prefill through _chunk_prefill (the "
+                "scheduler's chunked path), not the contiguous program"
+            )
         first, cache = self._jit_prefill(
             self.params, prompt, pad_offset, self._next_rng()
         )
         return first, cache
 
+    def _chunk_prefill(self, tokens, slot, start, valid):
+        """Scheduler-facing chunk closure: runs one compiled chunk and
+        swaps the donated pool; returns the device token sampled at the
+        chunk's last valid position (read only for the final chunk)."""
+        first, new_cache = self._jit_prefill(
+            self.params, self.pool.cache, self.pool.device_table(),
+            tokens, slot, start, valid, self._next_rng(),
+        )
+        self.pool.swap(new_cache)
+        return first
+
     def _decode(self, cache, prev_tokens, override_vals, override_mask,
                 active_mask, pad):
-        nxt, new_cache = self._jit_decode(
-            self.params, cache, prev_tokens, override_vals, override_mask,
-            active_mask, pad, self._next_rng(),
-        )
+        if self.paged:
+            nxt, new_cache = self._jit_decode(
+                self.params, cache, self.pool.device_table(), prev_tokens,
+                override_vals, override_mask, active_mask, pad,
+                self._next_rng(),
+            )
+        else:
+            nxt, new_cache = self._jit_decode(
+                self.params, cache, prev_tokens, override_vals,
+                override_mask, active_mask, pad, self._next_rng(),
+            )
         return nxt, new_cache
 
     # -- tensor-parallel serving -------------------------------------------
@@ -338,9 +565,6 @@ class InferenceEngine:
         repl = NamedSharding(mesh, P())
         p_sh = named(param_specs(self.params, rules))
         pool_sh = named(decode_cache_specs(self.pool.cache))
-        prefill_cache = make_decode_cache(self.decode_module, 1,
-                                          self.pool.max_len)
-        prefill_sh = named(decode_cache_specs(prefill_cache))
 
         # Place params and the (still-empty) pool on the mesh, then
         # re-jit so both programs lower via GSPMD with these layouts.
@@ -349,6 +573,31 @@ class InferenceEngine:
             jax.device_put(self.pool.cache, pool_sh),
             jax.device_put(self.pool.pad, repl),
         )
+        if self.paged:
+            # Both paged programs take (params, pool, table, ...): the
+            # block pool shards over heads exactly like the contiguous
+            # layout (decode_cache_specs keys on leaf NAME, and block
+            # leaves keep heads at dim 1); the block table and every
+            # scalar/lane operand replicate. Chunk prefill writes the
+            # sharded pool directly, so there is no separate prefill
+            # cache to lay out.
+            self.pool.table.sharding = repl
+            self.pool.table.invalidate()
+            self._make_jits(
+                in_shardings=(
+                    (p_sh, pool_sh) + (repl,) * 6,             # prefill
+                    (p_sh, pool_sh) + (repl,) * 7,             # decode
+                ),
+                out_shardings=(
+                    (repl, pool_sh),                           # prefill
+                    (repl, pool_sh),                           # decode
+                ),
+            )
+            self.mesh = mesh
+            return self
+        prefill_cache = make_decode_cache(self.decode_module, 1,
+                                          self.pool.max_len)
+        prefill_sh = named(decode_cache_specs(prefill_cache))
         self._make_jits(
             in_shardings=(
                 (p_sh, repl, repl, repl),                      # prefill
@@ -536,7 +785,7 @@ class InferenceEngine:
                 "failure_ratio": None, "last": None}
 
     def stats(self) -> dict:
-        return {
+        out = {
             **self.metrics.summary(),
             "prefill_traces": self._prefill_traces,
             "decode_traces": self._decode_traces,
@@ -544,6 +793,11 @@ class InferenceEngine:
             "pool_active": self.pool.active_count,
             "pool_free": self.pool.free_count,
         }
+        if self.paged:
+            out["kv_blocks_free"] = self.pool.free_blocks
+            out["kv_blocks_total"] = self.pool.num_blocks
+            out.update(self.pool.prefix_stats())
+        return out
 
     def mount_ops(self, port: int = 0, host: Optional[str] = None):
         """Mount a live introspection endpoint (``obs.opsd``) for this

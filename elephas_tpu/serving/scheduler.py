@@ -133,6 +133,24 @@ class _Active:
     token_times: List[float]             # wall time per token, for ITL
     budget: int                          # tokens still allowed (cache cap)
     admitted_at: float = 0.0             # decode-batch join time (spans)
+    next_col: int = 0                    # paged: column the next decode writes
+
+
+@dataclass
+class _Prefilling:
+    """A slot mid-chunked-prefill (paged pools only): the prompt's
+    columns land chunk-by-chunk, interleaved with decode steps when a
+    per-step chunk budget is set. Holds only the device token from the
+    LATEST chunk — it is read (one fetch) at finalize, never between
+    chunks."""
+
+    request: Request
+    slot: int
+    matched: int                         # prefix-cache tokens reused
+    next_col: int                        # next prompt column to prefill
+    t_pop: float
+    t_pre0: Optional[float] = None
+    first_dev: Any = None
 
 
 @dataclass
@@ -162,6 +180,17 @@ class ContinuousBatchingScheduler:
         whose cache index vectors may advance. The cache argument is
         DONATED — callers must treat it as dead and use ``new_cache``
         (the scheduler swaps it into the pool immediately).
+    ``chunk_prefill_fn(tokens, slot, start, valid) -> first_token``
+        paged pools only: one prompt CHUNK for one slot through the
+        block table. ``tokens`` is the (1, prefill_chunk) right-padded
+        chunk, ``start`` the slot column it begins at, ``valid`` its
+        real token count; the returned DEVICE scalar is the token
+        sampled at the chunk's last valid position (read only for the
+        final chunk). When set, the scheduler runs the paged admission
+        path: prefix-cache match at admission, chunked prefill
+        (``prefill_chunks_per_step`` bounds chunks dispatched per step;
+        None runs every pending chunk at admission), block backing per
+        decode column, and chain-publishing release.
     """
 
     def __init__(
@@ -177,11 +206,20 @@ class ContinuousBatchingScheduler:
         pipeline: bool = True,
         tracer=None,
         load=None,
+        chunk_prefill_fn: Optional[Callable] = None,
+        prefill_chunk: Optional[int] = None,
+        prefill_chunks_per_step: Optional[int] = None,
     ):
         self.pool = pool
         self.queue = queue
         self.prefill_fn = prefill_fn
         self.decode_fn = decode_fn
+        self.chunk_prefill_fn = chunk_prefill_fn
+        self.paged = chunk_prefill_fn is not None
+        self.prefill_chunk = (
+            prefill_chunk if prefill_chunk is not None else max_prompt_len
+        )
+        self.prefill_chunks_per_step = prefill_chunks_per_step
         self.max_prompt_len = max_prompt_len
         self.pad_token = pad_token
         self.metrics = metrics
@@ -198,6 +236,7 @@ class ContinuousBatchingScheduler:
         # cheap early return, so recording can stay in the hot path.
         self.tracer = tracer if tracer is not None else obs.default_tracer()
         self._active: Dict[int, _Active] = {}  # slot -> _Active
+        self._prefilling: Dict[int, _Prefilling] = {}  # paged mid-prefill
         self._results: List[GenerationResult] = []
         self._inflight: Optional[_Inflight] = None
         # slot -> first token to splice into the NEXT dispatch (set by
@@ -214,6 +253,7 @@ class ContinuousBatchingScheduler:
     def has_work(self) -> bool:
         return (
             bool(self._active)
+            or bool(self._prefilling)
             or len(self.queue) > 0
             or self._inflight is not None
         )
@@ -221,7 +261,17 @@ class ContinuousBatchingScheduler:
     # -- lifecycle ---------------------------------------------------------
 
     def _finish(self, entry: _Active, status: str) -> GenerationResult:
-        self.pool.release(entry.slot)
+        if self.paged:
+            # Publish the slot's token chain to the prefix cache before
+            # the block references drop: exactly the columns with K/V
+            # deterministically on device — ``next_col`` counts
+            # dispatched writes, including the pipelined in-flight step
+            # (device-ordered before any later sharer's gather).
+            chain = (list(entry.request.prompt)
+                     + list(entry.tokens))[:entry.next_col]
+            self.pool.release(entry.slot, tokens=chain)
+        else:
+            self.pool.release(entry.slot)
         del self._active[entry.slot]
         self._overrides.pop(entry.slot, None)
         req = entry.request
@@ -276,40 +326,80 @@ class ContinuousBatchingScheduler:
                 where="decode", tokens=len(entry.tokens),
             )
             self._finish(entry, "timeout")
+        for slot in [
+            s for s, pf in self._prefilling.items()
+            if pf.request.deadline is not None and now >= pf.request.deadline
+        ]:
+            pf = self._prefilling.pop(slot)
+            req = pf.request
+            obs.default_flight_recorder().note(
+                "deadline_eviction", "warn", req_id=req.req_id,
+                where="prefill", tokens=0,
+            )
+            # Drop the slot's half-written blocks (no chain to publish —
+            # the prompt never finished landing).
+            self.pool.release(slot)
+            result = GenerationResult(
+                req_id=req.req_id, tokens=[], status="timeout",
+                prompt_tokens=len(req.prompt),
+            )
+            if self.tracer.enabled:
+                track = f"req:{req.req_id}"
+                self.tracer.record(
+                    "queue", req.submitted_at, pf.t_pop, track=track,
+                    req_id=req.req_id,
+                )
+                self.tracer.record(
+                    "request", req.submitted_at, now, track=track,
+                    req_id=req.req_id, status="timeout", tokens=0,
+                )
+            self._results.append(result)
+            if self.metrics is not None:
+                self.metrics.record_finish(
+                    result, queue_depth=len(self.queue),
+                    active=len(self._active),
+                )
+
+    def _expire_queued(self, req: Request, t_pop: float) -> None:
+        """Account a request that expired while still queued — don't
+        burn a prefill on it."""
+        track = f"req:{req.req_id}"
+        obs.default_flight_recorder().note(
+            "deadline_eviction", "warn", req_id=req.req_id,
+            where="queue", tokens=0,
+        )
+        self.tracer.record(
+            "queue", req.submitted_at, t_pop, track=track,
+            req_id=req.req_id,
+        )
+        self.tracer.record(
+            "request", req.submitted_at, t_pop, track=track,
+            req_id=req.req_id, status="timeout", tokens=0,
+        )
+        self._results.append(GenerationResult(
+            req_id=req.req_id, tokens=[], status="timeout",
+            prompt_tokens=len(req.prompt),
+        ))
+        if self.metrics is not None:
+            self.metrics.record_finish(
+                self._results[-1], queue_depth=len(self.queue),
+                active=len(self._active),
+            )
 
     def _admit_from_queue(self) -> None:
         import jax.numpy as jnp
 
+        if self.paged:
+            self._admit_paged()
+            return
         while self.pool.free_count > 0:
             req = self.queue.pop()
             if req is None:
                 return
             t_pop = self.clock()
             track = f"req:{req.req_id}"
-            # A request can expire while still queued — don't burn a
-            # prefill on it.
             if req.deadline is not None and t_pop >= req.deadline:
-                obs.default_flight_recorder().note(
-                    "deadline_eviction", "warn", req_id=req.req_id,
-                    where="queue", tokens=0,
-                )
-                self.tracer.record(
-                    "queue", req.submitted_at, t_pop, track=track,
-                    req_id=req.req_id,
-                )
-                self.tracer.record(
-                    "request", req.submitted_at, t_pop, track=track,
-                    req_id=req.req_id, status="timeout", tokens=0,
-                )
-                self._results.append(GenerationResult(
-                    req_id=req.req_id, tokens=[], status="timeout",
-                    prompt_tokens=len(req.prompt),
-                ))
-                if self.metrics is not None:
-                    self.metrics.record_finish(
-                        self._results[-1], queue_depth=len(self.queue),
-                        active=len(self._active),
-                    )
+                self._expire_queued(req, t_pop)
                 continue
             plen = len(req.prompt)
             pad = self.max_prompt_len - plen
@@ -354,6 +444,117 @@ class ContinuousBatchingScheduler:
             else:
                 self._overrides[slot] = first
 
+    # -- paged admission: prefix match + chunked prefill ---------------------
+
+    def _admit_paged(self) -> None:
+        """Paged admission: claim a slot, bind the longest resident
+        prompt prefix (refcount bumps, zero prefill compute), and park
+        the request mid-prefill — ``_advance_prefills`` lands the
+        remaining columns chunk by chunk."""
+        while self.pool.free_count > 0:
+            req = self.queue.pop()
+            if req is None:
+                return
+            t_pop = self.clock()
+            if req.deadline is not None and t_pop >= req.deadline:
+                self._expire_queued(req, t_pop)
+                continue
+            slot = self.pool.acquire()
+            assert slot is not None  # guarded by free_count above
+            matched = self.pool.admit_prefix(slot, req.prompt)
+            self._prefilling[slot] = _Prefilling(
+                request=req, slot=slot, matched=matched,
+                next_col=matched, t_pop=t_pop,
+            )
+
+    def _run_chunk(self, pf: _Prefilling) -> None:
+        """Dispatch ONE prefill chunk for a parked request: back its
+        columns with blocks, launch the compiled chunk (non-blocking),
+        and finalize the slot into the decode batch when the prompt's
+        last column has landed."""
+        import jax.numpy as jnp
+
+        req = pf.request
+        plen = len(req.prompt)
+        start = pf.next_col
+        valid = min(self.prefill_chunk, plen - start)
+        if pf.t_pre0 is None:
+            pf.t_pre0 = self.clock()
+        self.pool.ensure_cols(pf.slot, start + valid)
+        chunk = list(req.prompt[start:start + valid])
+        chunk += [self.pad_token] * (self.prefill_chunk - valid)
+        tokens = jnp.asarray(  # host-ok: host list → device upload
+            [chunk], jnp.int32
+        )
+        pf.first_dev = self.chunk_prefill_fn(
+            tokens, jnp.int32(pf.slot), jnp.int32(start), jnp.int32(valid),
+        )
+        pf.next_col = start + valid
+        if pf.next_col >= plen:
+            self._finalize_prefill(pf)
+
+    def _finalize_prefill(self, pf: _Prefilling) -> None:
+        """Every prompt column is on device: fetch the first generated
+        token (the ONE prefill-path sync, same as the contiguous
+        admission), publish the prompt to the prefix cache, and join the
+        decode batch."""
+        req = pf.request
+        first = host_sync.fetch_scalar(pf.first_dev)
+        t_pre1 = self.clock()
+        del self._prefilling[pf.slot]
+        self.pool.commit_prefix(pf.slot, req.prompt)
+        self.pool.admitted_total += 1
+        # Same budget as the contiguous pool (capacity from the FIXED
+        # prompt width, not this prompt's length) — oracle parity.
+        budget = min(
+            req.max_new_tokens, self.pool.max_len - self.max_prompt_len
+        )
+        entry = _Active(
+            request=req, slot=pf.slot, tokens=[first],
+            token_times=[self.clock()], budget=budget,
+            next_col=len(req.prompt),
+        )
+        entry.admitted_at = self.clock()
+        self._active[pf.slot] = entry
+        if self.tracer.enabled:
+            track = f"req:{req.req_id}"
+            self.tracer.record(
+                "queue", req.submitted_at, pf.t_pop, track=track,
+                req_id=req.req_id,
+            )
+            self.tracer.record(
+                "prefill", pf.t_pre0, t_pre1, track=track,
+                req_id=req.req_id, prompt_tokens=len(req.prompt),
+                cached_tokens=pf.matched,
+            )
+            self.tracer.record(
+                "admit", pf.t_pop, entry.admitted_at, track=track,
+                req_id=req.req_id, slot=pf.slot,
+            )
+        if first == req.stop_token or len(entry.tokens) >= budget:
+            self._finish(entry, "completed")
+        else:
+            self._overrides[pf.slot] = first
+
+    def _advance_prefills(self) -> None:
+        """Run parked prefills forward, FIFO by admission order. With no
+        per-step budget every pending chunk runs now (admission costs
+        the same step it always did); with ``prefill_chunks_per_step``
+        set, at most that many chunks dispatch — long prompts spread
+        over several steps so in-flight decodes keep their ITL."""
+        if not self._prefilling:
+            return
+        budget = self.prefill_chunks_per_step
+        pending = list(self._prefilling.values())
+        ran = 0
+        for pf in pending:
+            while pf.slot in self._prefilling and \
+                    self._prefilling[pf.slot] is pf:
+                if budget is not None and ran >= budget:
+                    return
+                self._run_chunk(pf)
+                ran += 1
+
     # -- the decode hot path -----------------------------------------------
 
     def _dispatch(self, prev_tokens) -> _Inflight:
@@ -372,6 +573,13 @@ class ContinuousBatchingScheduler:
         lanes = sorted(self._active.items())
         for slot, _ in lanes:
             active_mask[slot] = True
+        if self.paged:
+            # Back (and exclusively own) the column each lane writes
+            # this step BEFORE the engine closure snapshots the device
+            # block table.
+            for slot, entry in lanes:
+                self.pool.ensure_decode_col(slot, entry.next_col)
+                entry.next_col += 1
         nxt, new_cache = self.decode_fn(
             self.pool.cache, prev_tokens, override_vals, override_mask,
             active_mask, self.pool.pad,
@@ -443,6 +651,7 @@ class ContinuousBatchingScheduler:
         # Host bookkeeping below overlaps the just-dispatched step.
         self._evict_expired()
         self._admit_from_queue()
+        self._advance_prefills()
         if self._inflight is None and self._active:
             # Cold start: the pool was empty at the top of the step and
             # admissions just filled it — dispatch now rather than
@@ -456,6 +665,7 @@ class ContinuousBatchingScheduler:
         pipelined path is tested token-identical against."""
         self._evict_expired()
         self._admit_from_queue()
+        self._advance_prefills()
         if not self._active:
             return 0
         inflight = self._dispatch(self._host_prev_tokens())
@@ -478,12 +688,20 @@ class ContinuousBatchingScheduler:
                 tokens=emitted, step_seconds=t1 - t0,
             )
         if self.load is not None:
+            # Paged pools report BLOCK-granular KV pressure (free blocks
+            # beat free slots once blocks are shared across slots).
+            kv = (self.pool.load_signals()
+                  if hasattr(self.pool, "load_signals") else {})
+            kv_free_frac = (
+                kv["kv_blocks_free"] / max(1, kv["kv_blocks_total"])
+                if kv else self.pool.free_count / self.pool.max_slots
+            )
             self.load.observe(
                 queue_depth=len(self.queue),
                 queue_limit=self.queue.max_depth,
                 active=len(self._active),
                 max_slots=self.pool.max_slots,
-                kv_free_frac=self.pool.free_count / self.pool.max_slots,
+                kv_free_frac=kv_free_frac,
                 admitted_total=(self.metrics.requests_submitted
                                 if self.metrics else 0),
                 rejected_total=(self.metrics.requests_rejected
@@ -491,6 +709,9 @@ class ContinuousBatchingScheduler:
                 tokens_total=(self.metrics.tokens_out
                               if self.metrics else 0),
                 now=t1,
+                kv_blocks_free=kv.get("kv_blocks_free"),
+                kv_blocks_total=kv.get("kv_blocks_total"),
+                prefix_hit_rate=kv.get("prefix_hit_rate"),
             )
         return self._results[before:]
 

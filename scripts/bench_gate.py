@@ -76,6 +76,19 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             # dipping under 0.9 means latency promises broke or the
             # ledger started counting canaries.
             ("goodput_ratio", "floor", 0.90),
+            # Paged-pool --prefix row. The prefix cache must pay for
+            # itself on the shared-system-prompt multi-turn workload
+            # (absolute floor — below half, resident prefixes are
+            # being missed or evicted prematurely); the paged layout
+            # must serve the SAME token streams as the contiguous
+            # oracle engine (identity is correctness, not perf, same
+            # discipline as the fleet router's token_identical); and
+            # chunked prefill must keep the decode ITL p99 at or below
+            # the unchunked arm's — the chunk budget exists to shrink
+            # that tail, a ratio over 1.0 means it traded it away.
+            ("prefix_hit_rate", "floor", 0.5),
+            ("token_identical", "equal", 0.0),
+            ("chunked_itl_ratio", "limit", 1.0),
         ],
     ),
     "ps": (

@@ -51,6 +51,25 @@ def _load_cell(snap: dict, name: str, status: str) -> str:
     return f"{score:.2f}" if score is not None else "-"
 
 
+def _kv_cell(snap: dict, name: str, status: str) -> str:
+    """KV column: block-granular cache pressure from the proc's /load
+    signals — free/total KV blocks plus the prefix-cache hit rate in
+    parentheses when the engine has one (paged pools only; contiguous
+    pools and non-serving procs render '-')."""
+    if status != "alive":
+        return "-"
+    doc = (snap.get("load") or {}).get(name) or {}
+    sig = doc.get("signals") or {}
+    total = sig.get("kv_blocks_total")
+    if not total:
+        return "-"
+    cell = f"{sig.get('kv_blocks_free', '?')}/{total}"
+    rate = sig.get("prefix_hit_rate")
+    if rate is not None:
+        cell += f"({100.0 * rate:.0f}%)"
+    return cell
+
+
 def _goodput_cell(snap: dict, name: str, status: str) -> str:
     """GOODPUT column: the proc's worst-objective goodput ratio from
     its /slo snapshot, as a percentage; '-' when stale/dead or before
@@ -96,8 +115,8 @@ def render(snap: dict) -> str:
     # ROLE is 12 wide: shard-group members report differentiated roles
     # ("ps/shard0", "ps/standby"), not just the flat "ps"/"worker".
     lines.append(f"{'NAME':<10} {'ROLE':<12} {'STATUS':<7} {'BOOT':<14} "
-                 f"{'WORKER':<8} {'LAST OK':>8} {'LOAD':>5} {'GOODPUT':>8}"
-                 f"  URL")
+                 f"{'WORKER':<8} {'LAST OK':>8} {'LOAD':>5} {'GOODPUT':>8} "
+                 f"{'KV':>13}  URL")
     for name, p in sorted(snap["processes"].items()):
         meta = p.get("meta") or {}
         ago = p.get("last_ok_s_ago")
@@ -107,7 +126,8 @@ def render(snap: dict) -> str:
             f"{str(meta.get('worker_id') or '-'):<8} "
             f"{('%.1fs' % ago) if ago is not None else '-':>8} "
             f"{_load_cell(snap, name, p['status']):>5} "
-            f"{_goodput_cell(snap, name, p['status']):>8}  {p['url']}"
+            f"{_goodput_cell(snap, name, p['status']):>8} "
+            f"{_kv_cell(snap, name, p['status']):>13}  {p['url']}"
         )
     metrics = snap["metrics"]
     if metrics["counters"]:

@@ -92,6 +92,17 @@ vocabulary is AST-read from ``opsd.py`` like the kind tables. Escape
 pragma: ``# route-ok``, for test-local throwaway routes. This rule also
 scans ``scripts/``.
 
+An eighth rule guards the paged pool's DONATION BOUNDARY: the
+``PagedKVPool`` cache pytree is donated to every compiled program that
+rewrites it (chunk prefill, paged decode, copy-on-write block copies),
+and the ONLY safe access path is the pool's guarded ``cache`` property
+plus ``swap()`` to reinstall — both live in ``serving/kv_pool.py``. An
+attribute read of ``._cache`` / ``._pad`` anywhere else in the serving
+package reaches past the ``DonatedBufferError`` guard and can hand out
+deleted buffers that surface as opaque XLA errors far from the bug.
+Flagged outside ``kv_pool.py``; escape pragma ``# pool-ok``, for code
+that provably holds a never-donated tree.
+
 Wired into tier-1 via ``tests/test_lint_blocking.py``; also runnable
 standalone: ``python scripts/lint_blocking.py`` (exit 1 on violations).
 """
@@ -111,6 +122,9 @@ CLOCK_PRAGMA = "clock-ok"
 METRIC_PRAGMA = "metric-ok"
 KIND_PRAGMA = "kind-ok"
 ROUTE_PRAGMA = "route-ok"
+POOL_PRAGMA = "pool-ok"
+POOL_SANCTIONED = "kv_pool.py"
+_POOL_PRIVATE = ("_cache", "_pad")
 _NUMPY_NAMES = ("np", "numpy")
 _CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
 _PICKLE_ATTRS = ("dumps", "loads", "dump", "load")
@@ -148,6 +162,15 @@ class Violation(NamedTuple):
                 f"`_seconds`; an f-string name bakes a dimension into it — "
                 f"use labelnames=; `# {METRIC_PRAGMA}` for deliberate "
                 f"foreign names)\n    {self.line.strip()}"
+            )
+        if self.domain == "pool":
+            return (
+                f"{self.path}:{self.lineno}: donated-pool internal "
+                f"{self.call} read outside kv_pool.py — donated buffers "
+                f"must go through the guarded `pool.cache`/`pool.pad` "
+                f"properties and `pool.swap()` (a raw `._cache` read can "
+                f"hand out deleted buffers; `# {POOL_PRAGMA}` only for a "
+                f"tree provably never donated)\n    {self.line.strip()}"
             )
         if self.domain == "resilience":
             what = "raw sleep" if self.call == "time.sleep" \
@@ -507,12 +530,45 @@ def lint_route_package(pkg_root: Path,
     return out
 
 
+def lint_pool_file(path: Path) -> List[Violation]:
+    """Attribute READS of the pool's private donated leaves. Writes
+    (``x._cache = …``) are equally foreign outside the pool, so any
+    ``._cache`` / ``._pad`` attribute node is flagged regardless of
+    load/store context — the distinction isn't worth the subtlety."""
+    source = path.read_text()
+    lines = source.splitlines()
+    tree = ast.parse(source, filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Attribute)
+                and node.attr in _POOL_PRIVATE):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if POOL_PRAGMA in line:
+            continue
+        out.append(Violation(str(path), node.lineno, f"`.{node.attr}`",
+                             line, domain="pool"))
+    return out
+
+
+def lint_pool_package(root: Path) -> List[Violation]:
+    """Lint the serving package tree except the pool module itself —
+    the only file allowed to touch the donated leaves directly."""
+    out = []
+    for path in sorted(root.rglob("*.py")):
+        if path.name == POOL_SANCTIONED:
+            continue
+        out.extend(lint_pool_file(path))
+    return out
+
+
 def main(argv: List[str] | None = None) -> List[Violation]:
     args = list(sys.argv[1:] if argv is None else argv)
     pkg_root = Path(__file__).resolve().parent.parent / "elephas_tpu"
     root = Path(args[0]) if args else (pkg_root / "serving")
     violations = lint_package(root)
     if not args:
+        violations.extend(lint_pool_package(pkg_root / "serving"))
         violations.extend(lint_pickle_package(pkg_root / "parameter"))
         violations.extend(lint_resilience_package(pkg_root / "resilience"))
         violations.extend(lint_metric_package(pkg_root))

@@ -370,3 +370,32 @@ def test_fleet_autoscale_rules_are_exact():
         "mode": "fleet_autoscale", "scaled_up_under_burst": False,
         "scaled_down_after_cooldown": True}], "fleet"))
     assert not stuck[("fleet_autoscale", "scaled_up_under_burst")]["ok"]
+
+
+def test_prefix_rules_gate_hit_rate_identity_and_itl_tail():
+    """The lm_bench --prefix row: hit rate is an absolute floor (0.5),
+    paged-vs-contiguous token identity is exact, and the chunked/
+    unchunked ITL p99 ratio is an absolute ceiling at 1.0 — a fresh
+    ratio worse than baseline but still under 1.0 passes (the claim is
+    'chunking never lengthens the tail', not a baseline diff)."""
+    base = [{"mode": "serving_prefix", "pipeline": True,
+             "prefix_hit_rate": 0.62, "token_identical": True,
+             "chunked_itl_ratio": 0.71, "all_completed": True}]
+    drifted = bg.compare(base, [dict(base[0], prefix_hit_rate=0.55,
+                                     chunked_itl_ratio=0.97)], "serve")
+    assert all(c["ok"] for c in drifted)
+    broken = bg.compare(base, [dict(base[0], prefix_hit_rate=0.4,
+                                    token_identical=False,
+                                    chunked_itl_ratio=1.3)], "serve")
+    failed = sorted(c["metric"] for c in broken if not c["ok"])
+    assert failed == ["chunked_itl_ratio", "prefix_hit_rate",
+                      "token_identical"]
+    by = _checks_by_metric(bg.compare(base, base, "serve"))
+    key = "serving_prefix/True"
+    assert (key, "prefix_hit_rate") in by
+    # Rows without the prefix metrics (the plain serving arms) are
+    # untouched by the new rules.
+    plain = [{"mode": "serving", "pipeline": True,
+              "tokens_per_sec": 100.0, "all_completed": True}]
+    plain_by = _checks_by_metric(bg.compare(plain, plain, "serve"))
+    assert ("serving/True", "prefix_hit_rate") not in plain_by

@@ -536,6 +536,34 @@ def test_fleet_top_renders_load_and_goodput_columns():
     assert row_gone.split()[-3:-1] == ["-", "-"]
 
 
+def test_fleet_top_renders_kv_column():
+    """A paged-serving proc's /load signals carry block-granular KV
+    pressure and the prefix hit rate; the KV column renders them as
+    free/total(hit%) — and '-' for procs without a paged pool."""
+    import scripts.fleet_top as fleet_top
+
+    bodies = _fake_bodies()
+    bodies["/load"] = json.dumps({
+        "score": 0.2,
+        "signals": {"kv_blocks_free": 5, "kv_blocks_total": 12,
+                    "prefix_hit_rate": 0.5},
+    }).encode()
+    agg = FleetAggregator(clock=lambda: 0.0,
+                          fetch=_fake_fetch_factory({
+                              "http://a": bodies,
+                              "http://b": _fake_bodies(),  # no paged pool
+                          }))
+    agg.add("http://a", name="a")
+    agg.add("http://b", name="b")
+    agg.poll(now=0.0)
+    board = fleet_top.render(agg.snapshot(now=0.0))
+    row_a = next(ln for ln in board.splitlines() if ln.startswith("a "))
+    assert "5/12(50%)" in row_a
+    row_b = next(ln for ln in board.splitlines() if ln.startswith("b "))
+    assert row_b.split()[-2] == "-"
+    assert "KV" in board
+
+
 # --------------------------------------------------------------------------
 # /replicas federation (serving-fleet router roster)
 # --------------------------------------------------------------------------

@@ -271,6 +271,13 @@ def test_real_goodput_identical_with_canaries_on_and_off(compiled):
 
     def serve(canaried):
         eng = _engine(compiled, queue_depth=16)
+        # Warm both compiled programs OUT of the measurement, then reset
+        # the ledger: the paged pool's gather/scatter programs compile
+        # slowly enough that a cold-start request trips the ITL
+        # objective by itself — in the canaried arm the first probe
+        # would absorb that cost and break the symmetry this test pins.
+        eng.result(eng.submit([5, 3, 9], max_new_tokens=2), timeout_s=120)
+        eng.slo = obs.GoodputLedger(clock=eng.clock)
         driver = obs.CanaryDriver(eng) if canaried else None
         for i in range(4):
             if driver is not None and i % 2 == 0:

@@ -428,3 +428,60 @@ def test_lint_package_recurses_into_subpackages(tmp_path):
     violations = lint.lint_package(pkg)
     assert len(violations) == 1
     assert violations[0].path.endswith("deep.py")
+
+
+# -- rule 8: donated-pool internals stay behind the kv_pool boundary -----
+
+
+def test_paged_vocab_entries_are_registered():
+    """The paged pool's eviction narration and its registry mirror names
+    conform to the registered vocabularies: ``prefix_evict`` is a
+    table kind (not a pragma'd free string), and the mirror counters
+    follow the ``_total`` naming rule the metric lint enforces."""
+    kinds, _ = lint.load_registered_vocab(_pkg_root())
+    assert "prefix_evict" in set(kinds)
+    kv_pool = _pkg_root() / "serving" / "kv_pool.py"
+    src = kv_pool.read_text()
+    assert "serving_prefix_cache_hit_total" in src
+    assert "serving_prefix_cache_lookup_total" in src
+    assert "serving_kv_blocks_free" in src
+    assert lint.lint_metric_file(kv_pool) == []
+    assert lint.lint_kind_file(kv_pool, *lint.load_registered_vocab(
+        _pkg_root())) == []
+
+
+def test_pool_lint_serving_is_clean():
+    """THE donation-boundary invariant: no serving module outside
+    kv_pool.py touches the pool's private donated leaves — stale
+    ``._cache`` aliases must fail tier-1 here, not as deep XLA
+    use-after-delete errors."""
+    violations = lint.lint_pool_package(_pkg_root() / "serving")
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_pool_lint_catches_reads_and_writes(tmp_path):
+    bad = tmp_path / "bad_pool.py"
+    bad.write_text(textwrap.dedent("""
+        def f(pool, tree):
+            stale = pool._cache
+            pad = pool._pad
+            pool._cache = tree
+            return stale, pad
+    """))
+    calls = sorted(v.call for v in lint.lint_pool_file(bad))
+    assert calls == ["`._cache`", "`._cache`", "`._pad`"]
+    msg = str(lint.lint_pool_file(bad)[0])
+    assert "pool.swap()" in msg and "pool-ok" in msg
+
+
+def test_pool_lint_pragma_and_sanctioned_module(tmp_path):
+    """The ``# pool-ok`` pragma exempts a line, and kv_pool.py itself —
+    the one module allowed to own the donated leaves — is skipped by
+    the package walk."""
+    pkg = tmp_path / "serving"
+    pkg.mkdir()
+    (pkg / "kv_pool.py").write_text(
+        "class P:\n    def f(self):\n        return self._cache\n")
+    (pkg / "other.py").write_text(
+        "def f(pool):\n    return pool._cache  # pool-ok: never donated\n")
+    assert lint.lint_pool_package(pkg) == []

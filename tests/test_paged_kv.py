@@ -1,0 +1,428 @@
+"""Paged KV pool: allocator invariants, copy-on-write, prefix caching,
+and chunked-prefill token identity.
+
+The block allocator's contract is conservation — a block is free iff
+its refcount is 0, and the refcount equals the number of holders (slot
+rows + prefix-cache entries) at all times, including after adversarial
+seeded churn. The serving contract is identity: the paged layout and
+the chunked prefill program must emit EXACTLY the tokens the contiguous
+oracle (``paged=False``) and the per-row ``generate()`` oracle emit,
+over the full matrix (ragged prompts, EOS stops, deadline evictions,
+prefix-cache hits, per-step chunk budgets).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu import obs
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.models import get_model
+from elephas_tpu.obs.flight import FlightRecorder
+from elephas_tpu.serving import (
+    DonatedBufferError,
+    InferenceEngine,
+    PagedKVPool,
+    PrefixCache,
+)
+from tests.test_serving import FakeClock, _per_row
+
+VOCAB, SEQ = 97, 64
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return CompiledModel(
+        get_model(
+            "transformer_lm", vocab_size=VOCAB, d_model=32, num_heads=4,
+            num_layers=2, max_seq_len=SEQ,
+        ),
+        optimizer={"name": "adam", "learning_rate": 3e-3},
+        loss="sparse_categorical_crossentropy",
+        metrics=[],
+        input_shape=(SEQ,),
+        input_dtype=jnp.int32,
+        seed=0,
+    )
+
+
+@pytest.fixture()
+def flight():
+    previous = obs.default_flight_recorder()
+    recorder = FlightRecorder(capacity=256)
+    obs.set_default_flight_recorder(recorder)
+    try:
+        yield recorder
+    finally:
+        obs.set_default_flight_recorder(previous)
+
+
+def _pool(compiled, max_slots=3, max_len=24, **kw):
+    decode_module = dataclasses.replace(
+        compiled.module, decode=True, attention="dense"
+    )
+    kw.setdefault("block_size", 4)
+    return PagedKVPool(decode_module, max_slots, max_len, **kw)
+
+
+def _paged_engine(compiled, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("max_len", 24)
+    kw.setdefault("queue_depth", 8)
+    kw.setdefault("paged", True)
+    return InferenceEngine(compiled, **kw)
+
+
+# -- allocator invariants ----------------------------------------------------
+
+
+def test_block_acquire_release_refcount_invariants(compiled):
+    pool = _pool(compiled)
+    assert pool.free_blocks == pool.num_blocks
+    slot = pool.acquire()
+    pool.ensure_cols(slot, 10)  # 3 blocks at block_size=4
+    assert pool.blocks_in_use == 3
+    held = [int(b) for b in pool.table.rows[slot] if b >= 0]
+    assert len(held) == 3 and all(pool._ref[b] == 1 for b in held)
+    pool.assert_block_invariants()
+    pool.release(slot)  # no chain: every block must come back
+    assert pool.free_blocks == pool.num_blocks
+    assert all(pool._ref[b] == 0 for b in held)
+    pool.assert_block_invariants()
+
+
+def test_slot_double_release_raises(compiled):
+    pool = _pool(compiled)
+    slot = pool.acquire()
+    pool.ensure_cols(slot, 4)
+    pool.release(slot)
+    with pytest.raises(ValueError, match="already free"):
+        pool.release(slot)
+
+
+def test_block_double_release_fails_loudly(compiled):
+    pool = _pool(compiled)
+    slot = pool.acquire()
+    pool.ensure_cols(slot, 4)
+    block = int(pool.table.rows[slot, 0])
+    pool._decref(block)  # simulate a corrupt row releasing early
+    with pytest.raises(RuntimeError, match="double-released"):
+        pool._decref(block)
+
+
+def test_wholesale_admit_is_refused(compiled):
+    pool = _pool(compiled)
+    with pytest.raises(RuntimeError, match="no wholesale admit"):
+        pool.admit(0, None, 0)
+
+
+def test_undersized_pool_dead_ends_loudly(compiled):
+    """With no prefix cache to evict, exhausting the blocks raises the
+    sizing error instead of looping."""
+    pool = _pool(compiled, max_slots=2, num_blocks=6, prefix_cache=False)
+    a, b = pool.acquire(), pool.acquire()
+    pool.ensure_cols(a, pool.virtual_len)  # 6 blocks: takes everything
+    with pytest.raises(RuntimeError, match="out of KV blocks"):
+        pool.ensure_cols(b, 4)
+
+
+def test_cow_fork_preserves_content_and_isolates_writes(compiled):
+    pool = _pool(compiled)
+    parent = pool.acquire()
+    pool.ensure_cols(parent, 8)
+    pblock = int(pool.table.rows[parent, 0])
+    # Stamp recognizable K/V into the parent's first block.
+    pool.swap(jax.tree_util.tree_map(
+        lambda leaf: leaf.at[pblock].set(7.5) if leaf.ndim == 4 else leaf,
+        pool.cache,
+    ))
+    child = pool.fork_slot(parent)
+    assert child is not None
+    assert int(pool.table.rows[child, 0]) == pblock  # aliased, not copied
+    assert pool._ref[pblock] == 2
+    fresh = pool.ensure_writable(child, 0)  # a "write" hits the COW guard
+    assert fresh != pblock
+    assert int(pool.table.rows[parent, 0]) == pblock
+    assert pool._ref[pblock] == 1 and pool._ref[fresh] == 1
+    for leaf in jax.tree_util.tree_leaves(pool.cache):
+        if leaf.ndim == 4:
+            # The fork's block is a faithful copy of the shared content.
+            np.testing.assert_array_equal(
+                np.asarray(leaf[fresh]), np.asarray(leaf[pblock])
+            )
+    # Writing the fork's copy must not touch the parent's block.
+    pool.swap(jax.tree_util.tree_map(
+        lambda leaf: leaf.at[fresh].set(-3.0) if leaf.ndim == 4 else leaf,
+        pool.cache,
+    ))
+    leaf = next(l for l in jax.tree_util.tree_leaves(pool.cache)
+                if l.ndim == 4)
+    assert float(np.asarray(leaf[pblock]).max()) == 7.5
+    pool.assert_block_invariants()
+
+
+def test_ensure_cols_rejects_past_virtual_length(compiled):
+    pool = _pool(compiled)
+    slot = pool.acquire()
+    with pytest.raises(ValueError, match="columns"):
+        pool.ensure_cols(slot, pool.virtual_len + 1)
+
+
+# -- prefix cache ------------------------------------------------------------
+
+
+def test_prefix_cache_matches_longest_strictly_shorter_prefix():
+    cache = PrefixCache(block_size=4)
+    incref = lambda b: None
+    cache.insert((1, 2, 3, 4, 5, 6, 7, 8), [10, 11], incref)
+    assert len(cache) == 2  # every full-block prefix registered
+    matched, blocks = cache.match((1, 2, 3, 4, 5, 6, 7, 8, 9))
+    assert matched == 8 and blocks == [10, 11]
+    # The exact chain is capped one block short: >= 1 token must prefill.
+    matched, blocks = cache.match((1, 2, 3, 4, 5, 6, 7, 8))
+    assert matched == 4 and blocks == [10]
+    assert cache.match((9, 9, 9, 9, 9))[0] == 0
+    assert cache.hits_total == 2 and cache.lookups_total == 3
+    assert cache.tokens_saved_total == 12
+
+
+def test_release_publishes_full_block_chain(compiled):
+    pool = _pool(compiled)
+    slot = pool.acquire()
+    pool.ensure_cols(slot, 10)
+    chain = list(range(30, 40))  # 10 tokens -> 2 full blocks resident
+    held = [int(b) for b in pool.table.rows[slot][:2]]
+    pool.release(slot, tokens=chain)
+    assert len(pool.prefix) == 2
+    assert all(pool._ref[b] > 0 for b in held)  # pinned by the cache
+    matched, blocks = pool.prefix.match(tuple(chain))
+    assert matched == 8 and blocks == held
+    pool.assert_block_invariants()
+
+
+def test_lru_eviction_under_pressure_notes_flight(compiled, flight):
+    """Allocation pressure evicts the LEAST-recently-used resident
+    prefix (flight kind ``prefix_evict``), never a slot-held block."""
+    pool = _pool(compiled, max_slots=2, max_len=8, block_size=4)
+    assert pool.num_blocks == 4
+    for start in (0, 40):  # two resident 1-block chains
+        slot = pool.acquire()
+        pool.ensure_cols(slot, 4)
+        pool.release(slot, tokens=list(range(start, start + 4)))
+    assert pool.free_blocks == 2 and len(pool.prefix) == 2
+    pool.prefix.match(tuple(range(0, 4)) + (9,))  # freshen the first chain
+    a, b = pool.acquire(), pool.acquire()
+    pool.ensure_cols(a, pool.virtual_len)  # 2 blocks: drains the free list
+    pool.ensure_cols(b, 4)  # 3rd block only exists by evicting a prefix
+    assert len(pool.prefix) == 1  # LRU (the 40.. chain) was evicted
+    assert pool.prefix.match(tuple(range(0, 4)) + (9,))[0] == 4
+    events = flight.events(kind="prefix_evict")
+    assert len(events) == 1
+    assert events[0].detail["blocks"] == 1
+    assert pool.prefix.evictions_total == 1
+    pool.assert_block_invariants()
+
+
+def test_free_count_conservation_after_seeded_churn(compiled):
+    """Adversarial churn — admissions with shared prefixes, forks, COW
+    writes, chain-publishing releases — conserves every block: the
+    invariant checker passes at every step and all blocks are accounted
+    for at the end."""
+    rng = np.random.default_rng(0)
+    pool = _pool(compiled, max_slots=4, max_len=16, block_size=4)
+    live = {}  # slot -> token chain
+    vocab = list(range(50, 60))
+    for _ in range(200):
+        op = rng.choice(["admit", "grow", "fork", "release"])
+        try:
+            if op == "admit" and pool.free_count > 0:
+                slot = pool.acquire()
+                prompt = [int(rng.choice(vocab))
+                          for _ in range(int(rng.integers(1, 9)))]
+                pool.admit_prefix(slot, prompt)
+                live[slot] = prompt
+                pool.ensure_cols(slot, len(prompt))
+            elif op == "grow" and live:
+                slot = int(rng.choice(list(live)))
+                upto = min(len(live[slot]) + int(rng.integers(0, 5)),
+                           pool.virtual_len)
+                pool.ensure_cols(slot, upto)
+                live[slot] += [int(rng.choice(vocab))
+                               for _ in range(upto - len(live[slot]))]
+                pool.ensure_writable(slot, upto - 1)
+            elif op == "fork" and live and pool.free_count > 0:
+                parent = int(rng.choice(list(live)))
+                child = pool.fork_slot(parent)
+                if child is not None:
+                    live[child] = list(live[parent])
+            elif op == "release" and live:
+                slot = int(rng.choice(list(live)))
+                pool.release(slot, tokens=live.pop(slot))
+        except RuntimeError as e:
+            # COW copies under full occupancy can legitimately exhaust
+            # the pool; partial allocation must still conserve blocks.
+            assert "out of KV blocks" in str(e)
+        pool.assert_block_invariants()
+    for slot in list(live):
+        pool.release(slot, tokens=live.pop(slot))
+    pool.assert_block_invariants()
+    # Every block is either free or pinned by a resident prefix entry.
+    resident = {b for e in pool.prefix._entries.values()
+                for b in e.blocks}
+    assert pool.free_blocks + len(resident) == pool.num_blocks
+
+
+# -- serving identity --------------------------------------------------------
+
+
+def _serve_all(eng, prompts, max_new_tokens=10, **submit_kw):
+    rids = [eng.submit(p, max_new_tokens=max_new_tokens, **submit_kw)
+            for p in prompts]
+    return [eng.result(r, timeout_s=120).tokens for r in rids]
+
+
+PROMPTS = [[5, 3, 9], [1, 2, 3, 4, 5, 6, 7], [11, 12]]
+
+
+def test_paged_identical_to_contiguous_oracle(compiled):
+    """THE tentpole pin: the paged layout (gather → same apply →
+    scatter) emits exactly the contiguous pool's tokens, at one prefill
+    and one decode compile, across block sizes that do and don't divide
+    the prompt/cache lengths."""
+    oracle = None
+    for kw in (dict(paged=False), dict(paged=True),
+               dict(paged=True, kv_block_size=4),
+               dict(paged=True, kv_block_size=5)):
+        eng = _paged_engine(compiled, **kw)
+        got = _serve_all(eng, PROMPTS)
+        st = eng.stats()
+        assert st["prefill_traces"] == 1 and st["decode_traces"] == 1
+        if oracle is None:
+            oracle = got
+        else:
+            assert got == oracle, kw
+    for prompt, tokens in zip(PROMPTS, oracle):
+        assert tokens == _per_row(compiled, prompt, 10)
+
+
+def test_chunked_prefill_identical_to_one_shot(compiled):
+    """Chunked prefill is the same math as one-shot (causal attention
+    decomposes over chunks): every chunk width and per-step budget
+    yields the per-row oracle's tokens, still one compile each."""
+    for chunk, per_step in ((3, None), (3, 1), (2, 2), (1, 1)):
+        eng = _paged_engine(compiled, kv_block_size=4, prefill_chunk=chunk,
+                            prefill_chunks_per_step=per_step)
+        got = _serve_all(eng, PROMPTS)
+        st = eng.stats()
+        assert st["prefill_traces"] == 1 and st["decode_traces"] == 1
+        for prompt, tokens in zip(PROMPTS, got):
+            assert tokens == _per_row(compiled, prompt, 10), (chunk, per_step)
+
+
+def test_chunked_prefill_eos_stop_identity(compiled):
+    free = _per_row(compiled, [5, 3, 9], 10)
+    stop = free[3]
+    eng = _paged_engine(compiled, stop_token=stop, kv_block_size=4,
+                        prefill_chunk=2, prefill_chunks_per_step=1)
+    res = eng.result(eng.submit([5, 3, 9], max_new_tokens=10), timeout_s=120)
+    assert res.status == "completed"
+    assert res.tokens == free[:4]
+    assert eng.pool.free_count == eng.pool.max_slots
+    eng.pool.assert_block_invariants()
+
+
+def test_chunked_prefill_deadline_eviction(compiled):
+    """A request whose deadline expires MID-CHUNKED-PREFILL times out
+    with zero tokens and returns every block it had bound."""
+    clock = FakeClock()
+    eng = _paged_engine(compiled, max_slots=1, clock=clock, kv_block_size=4,
+                        prefill_chunk=2, prefill_chunks_per_step=1)
+    busy = eng.submit([1, 2], max_new_tokens=40)
+    doomed = eng.submit([3, 4, 5, 6, 7, 8], max_new_tokens=5, timeout_s=2.0)
+    for _ in range(3):
+        eng.step()
+    clock.advance(5.0)  # doomed expires while queued behind the busy slot
+    eng.run_until_drained()
+    assert eng.result(doomed, timeout_s=10).status == "timeout"
+    assert eng.result(busy, timeout_s=10).status == "completed"
+    assert eng.pool.free_count == eng.pool.max_slots
+    eng.pool.assert_block_invariants()
+
+
+def test_deadline_eviction_mid_prefill_returns_blocks(compiled):
+    """Expiry of a PARKED mid-prefill slot (chunk budget starves it
+    while decode lanes run) releases the slot and its blocks."""
+    clock = FakeClock()
+    eng = _paged_engine(compiled, max_slots=2, clock=clock, kv_block_size=4,
+                        prefill_chunk=1, prefill_chunks_per_step=1)
+    busy = eng.submit([1, 2], max_new_tokens=30)
+    eng.step()  # busy admits and starts decoding
+    doomed = eng.submit([3, 4, 5, 6, 7, 8], max_new_tokens=5, timeout_s=1.0)
+    eng.step()  # doomed claims a slot; 1-chunk budget leaves it parked
+    assert eng.scheduler._prefilling  # mid-prefill, blocks bound
+    held = eng.pool.blocks_in_use
+    assert held > 0
+    clock.advance(3.0)
+    eng.run_until_drained()
+    assert eng.result(doomed, timeout_s=10).status == "timeout"
+    assert eng.result(busy, timeout_s=10).status == "completed"
+    assert eng.pool.free_count == eng.pool.max_slots
+    eng.pool.assert_block_invariants()
+
+
+def test_prefix_hit_skips_prefill_and_stays_identical(compiled):
+    """Back-to-back conversations sharing a full-block system prompt:
+    the later ones admit off resident blocks (hit counters move, saved
+    tokens accrue) and still emit oracle tokens."""
+    sys_prompt = [7, 8, 9, 10]
+    prompts = [sys_prompt + [1, 2], sys_prompt + [3, 4, 5], sys_prompt + [1, 2]]
+    eng = _paged_engine(compiled, max_slots=2, kv_block_size=4)
+    outs = []
+    for p in prompts:  # sequential turns → later ones can share
+        outs.append(eng.result(eng.submit(p, max_new_tokens=6),
+                               timeout_s=120).tokens)
+    for p, tokens in zip(prompts, outs):
+        assert tokens == _per_row(compiled, p, 6)
+    st = eng.stats()
+    assert st["prefix_hits"] == 2 and st["prefix_lookups"] == 3
+    assert st["prefix_tokens_saved"] == 8
+    assert st["prefix_hit_rate"] == pytest.approx(2 / 3)
+    eng.pool.assert_block_invariants()
+
+
+def test_paged_stats_and_load_signals(compiled):
+    eng = _paged_engine(compiled, kv_block_size=4)
+    _serve_all(eng, [[5, 3, 9]], max_new_tokens=4)
+    st = eng.stats()
+    assert st["kv_blocks_total"] == eng.pool.num_blocks
+    assert 0 <= st["kv_blocks_free"] <= st["kv_blocks_total"]
+    sig = eng.load.snapshot()["signals"]
+    assert sig["kv_blocks_total"] == eng.pool.num_blocks
+    assert sig["kv_free_frac"] == pytest.approx(
+        sig["kv_blocks_free"] / sig["kv_blocks_total"])
+    assert "prefix_hit_rate" in sig
+
+
+def test_paged_pool_donation_guard(compiled):
+    eng = _paged_engine(compiled, kv_block_size=4)
+    eng.submit([5, 3, 9], max_new_tokens=4)
+    eng.step()
+    stale = eng.pool.cache
+    eng.step()  # decode donates the pool; `stale` buffers die
+    assert any(leaf.is_deleted()
+               for leaf in jax.tree_util.tree_leaves(stale))
+    eng.run_until_drained()
+    eng.pool.swap(stale)
+    with pytest.raises(DonatedBufferError):
+        _ = eng.pool.cache
+
+
+def test_paged_shard_serving_refuses_warm_engine(compiled):
+    eng = _paged_engine(compiled, kv_block_size=4)
+    _serve_all(eng, [[5, 3, 9]], max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="before the first request"):
+        eng.shard_serving(None)
