@@ -36,7 +36,10 @@ from elephas_tpu import obs
 from elephas_tpu.engine.state import TrainState
 from elephas_tpu.engine.step import make_epoch_scanner, make_train_step
 from elephas_tpu.parallel.mesh import DATA_AXIS
-from elephas_tpu.parameter.client import ParameterServerUnavailable
+from elephas_tpu.parameter.client import (
+    ParameterServerUnavailable,
+    StaleDeltaRejected,
+)
 from elephas_tpu.parameter.server import make_server
 from elephas_tpu.utils.functional_utils import subtract_params
 
@@ -93,9 +96,31 @@ class _CommsPipeline:
     - Pull failures are NOT retried here — they surface to the waiting
       worker, whose ``run_unit`` owns unit-level retry exactly as on
       the serial path.
+    - ``StaleDeltaRejected`` is the PS admission policy's DEFINITIVE
+      answer, not a fault: the delta is dropped (re-sending it would be
+      MORE stale), the next ``pull()`` is forced onto fresh params even
+      if a prefetch is pending, and the push cadence tightens — see the
+      ratchet below. Never fatal, never retried.
     - After a fatal, the thread short-circuits the remaining queue
       (pushes complete without wire ops, pull boxes get the fatal) so
       ``flush``/``close`` never deadlock behind a dead server.
+
+    Adaptive sync-interval ratchet (bounded-staleness client half):
+    ``sync_interval`` is the worker's train-units-per-push target.
+    ``push()`` coalesces deltas (tree-sum — the exact delta the units
+    would have pushed one at a time, modulo apply interleaving, which
+    is Downpour's standard noise) and enqueues one wire push per
+    ``round(interval)`` units. A ``StaleDeltaRejected`` HALVES the
+    interval (floor 1.0 — push every unit) so consecutive rejections
+    converge on the tightest cadence; each accepted push ADDS 0.25
+    back, capped at the configured baseline (AIMD). The live value is
+    exported as the ``worker_sync_interval`` gauge and stamped onto the
+    client (``client.sync_interval``) so every push frame carries it to
+    the PS staleness ledger / fleet SYNC column. The default baseline
+    of 1.0 is a no-op ratchet: one push per unit, exactly the
+    pre-ratchet behavior, until a rejection proves the PS is enforcing
+    bounds (the interval can't drop below 1.0, so only the counters
+    move).
 
     ``flush()`` waits for every enqueued push to complete — called at
     each epoch boundary BEFORE ``on_epoch_done`` so the barrier snapshot
@@ -119,9 +144,15 @@ class _CommsPipeline:
     _PUSH_RETRY_DELAYS = (0.05, 0.1, 0.2)
 
     def __init__(self, client, worker_index: int, max_push_attempts: int,
-                 sleep=time.sleep):
+                 sleep=time.sleep, sync_interval: float = 1.0):
         """``sleep`` is injectable so retry/backoff tests assert the
-        schedule without real waits (tier-1 must not sleep)."""
+        schedule without real waits (tier-1 must not sleep).
+        ``sync_interval``: baseline train-units-per-push (>= 1.0); the
+        AIMD ratchet moves the live value between 1.0 and this cap."""
+        if sync_interval < 1.0:
+            raise ValueError(
+                f"sync_interval must be >= 1.0, got {sync_interval}"
+            )
         self._client = client
         self._sleep = sleep
         self._max_push_attempts = max(1, max_push_attempts)
@@ -132,10 +163,43 @@ class _CommsPipeline:
         self._push_cond = threading.Condition()
         self._pushes_enqueued = 0
         self._pushes_done = 0
+        # Ratchet state. _acc/_acc_units are touched only by the worker
+        # thread; _interval is written by the comms thread (reject /
+        # accept) and read by the worker thread — a float slot under the
+        # GIL, no lock needed. rejections is the test/ops-visible count.
+        self._baseline = float(sync_interval)
+        self._interval = float(sync_interval)
+        self._acc = None
+        self._acc_units = 0
+        self._repull = threading.Event()
+        self.rejections = 0
+        self._set_interval(self._interval)
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"worker{worker_index}-comms"
         )
         self._thread.start()
+
+    @property
+    def sync_interval(self) -> float:
+        """The live train-units-per-push interval (AIMD-adjusted)."""
+        return self._interval
+
+    def _set_interval(self, value: float) -> None:
+        """Move the ratchet: stamp the client (every subsequent push
+        frame carries the value to the PS ledger) and export the gauge."""
+        value = float(value)
+        self._interval = value
+        try:
+            self._client.sync_interval = value
+        except Exception:
+            pass  # a client that refuses the stamp just goes unlabeled
+        obs.default_registry().gauge(
+            "worker_sync_interval",
+            help="adaptive train-units-per-push interval (AIMD: halved "
+                 "on a stale-delta rejection, +0.25 per accept up to "
+                 "the configured baseline)",
+            labelnames=("worker",),
+        ).labels(worker=self._worker_label).set(value)
 
     # -- worker-side API ------------------------------------------------
 
@@ -150,10 +214,17 @@ class _CommsPipeline:
 
     def pull(self):
         """Consume the pending prefetch (or issue a synchronous pull),
-        blocking until the params arrive."""
+        blocking until the params arrive. After a stale-delta rejection
+        a pending prefetch is DISCARDED — its params predate the
+        rejection, and the whole point of the re-pull is to train the
+        next unit from the version line that refused us."""
         self._raise_if_fatal()
         box, self._pending = self._pending, None
+        if box is not None and self._repull.is_set():
+            box.event.wait()  # let the in-flight wire op finish cleanly
+            box = None
         if box is None:
+            self._repull.clear()
             box = _PullBox()
             self._put(self._item("pull", box))
         box.event.wait()
@@ -162,14 +233,33 @@ class _CommsPipeline:
         return box.value
 
     def push(self, delta) -> None:
-        """Fire-and-forget enqueue; blocks only when the bounded queue is
-        full (backpressure) or re-raises a recorded fatal."""
+        """Record one unit's delta; enqueues a WIRE push only when
+        ``round(interval)`` units have coalesced (tree-sum). Blocks only
+        when the bounded queue is full (backpressure) or re-raises a
+        recorded fatal."""
         self._raise_if_fatal()
+        if self._acc is None:
+            self._acc = delta
+        else:
+            self._acc = jax.tree_util.tree_map(
+                lambda a, b: a + b, self._acc, delta
+            )
+        self._acc_units += 1
+        if self._acc_units >= max(1, int(round(self._interval))):
+            self._enqueue_acc()
+
+    def _enqueue_acc(self) -> None:
+        delta, self._acc = self._acc, None
+        self._acc_units = 0
         with self._push_cond:
             self._pushes_enqueued += 1
         self._put(self._item("push", delta))
 
     def flush(self) -> None:
+        """Push any coalesced remainder, then wait for every enqueued
+        push to complete."""
+        if self._acc is not None:
+            self._enqueue_acc()
         with self._push_cond:
             while self._pushes_done < self._pushes_enqueued:
                 self._push_cond.wait(0.05)
@@ -245,9 +335,25 @@ class _CommsPipeline:
         for attempt in range(self._max_push_attempts):
             try:
                 self._client.update_parameters(delta)
+                if self._interval < self._baseline:
+                    # Additive recovery: each accepted push relaxes the
+                    # cadence back toward the configured baseline.
+                    self._set_interval(
+                        min(self._baseline, self._interval + 0.25)
+                    )
                 return
             except ParameterServerUnavailable as exc:
                 self._fatal = exc  # fail-fast contract: never retried
+                return
+            except StaleDeltaRejected:
+                # The admission policy's definitive answer: this delta
+                # is too stale and a re-send would be MORE stale. Drop
+                # it, force the next pull onto fresh params, and halve
+                # the units-per-push interval (multiplicative half of
+                # the AIMD ratchet) so the worker syncs more often.
+                self.rejections += 1
+                self._repull.set()
+                self._set_interval(max(1.0, self._interval / 2.0))
                 return
             except Exception as exc:
                 if attempt + 1 >= self._max_push_attempts:
@@ -285,6 +391,8 @@ class AsyncTrainer:
         ps_ops_port: Optional[int] = None,
         ps_shards: Optional[int] = None,
         standby: Optional[int] = None,
+        sync_interval: float = 1.0,
+        batches_per_unit: Optional[int] = None,
     ):
         """``pipelined_comms``: run each worker's PS traffic on a
         background comms thread (``_CommsPipeline``) — pushes become
@@ -356,7 +464,21 @@ class AsyncTrainer:
         ``standby``: with ``ps_shards``, keep one WAL-streamed warm
         spare per shard and promote it when the group's failure
         detector declares a primary dead (requires ``ps_wal_dir``).
-        Default ``$ELEPHAS_PS_STANDBY`` or 0."""
+        Default ``$ELEPHAS_PS_STANDBY`` or 0.
+
+        ``sync_interval``: baseline train-units-per-push for the
+        pipelined comms ratchet (>= 1.0; default 1.0 = push every
+        unit, the pre-ratchet cadence). Values > 1 coalesce that many
+        units' deltas per wire push — fewer round-trips, more
+        staleness; a PS enforcing bounded-staleness admission pushes
+        back with rejections, which HALVE the live interval (floor
+        1.0), while accepts relax it +0.25 back toward this baseline.
+
+        ``batches_per_unit``: with ``elastic=True``, cut each
+        ``(epoch, partition)`` ledger unit into batch ranges of this
+        many batches — a worker death mid-epoch re-leases only the
+        unfinished ranges, not whole partitions. Default None keeps
+        whole-partition units."""
         if frequency not in _FREQUENCIES:
             raise ValueError(
                 f"async frequency must be batch|epoch, got {frequency!r} "
@@ -382,6 +504,22 @@ class AsyncTrainer:
                 "which are epoch-granular — use frequency='epoch'"
             )
         self.elastic = elastic
+        if sync_interval < 1.0:
+            raise ValueError(
+                f"sync_interval must be >= 1.0, got {sync_interval}"
+            )
+        self.sync_interval = float(sync_interval)
+        if batches_per_unit is not None:
+            if batches_per_unit < 1:
+                raise ValueError(
+                    f"batches_per_unit must be >= 1, got {batches_per_unit}"
+                )
+            if not elastic:
+                raise ValueError(
+                    "batches_per_unit cuts ELASTIC ledger units into "
+                    "batch ranges — set elastic=True to use it"
+                )
+        self.batches_per_unit = batches_per_unit
         self.fault_plan = fault_plan
         self.ps_wal_dir = ps_wal_dir
         self.wal_every = wal_every
@@ -1185,7 +1323,9 @@ class AsyncTrainer:
     ) -> Tuple[TrainState, Dict[str, List[float]]]:
         """Elastic fit: the ledger/pool replaces the fixed worker loop.
 
-        Every ``(epoch, partition)`` unit is leased from a
+        Every ``(epoch, partition)`` unit — or, with
+        ``batches_per_unit`` set, every ``(epoch, partition, (lo, hi))``
+        batch range — is leased from a
         ``resilience.UnitLedger`` to whichever worker thread is alive;
         dead workers' in-flight units are re-queued to survivors, the
         per-epoch fire runs when the LEDGER says the epoch is complete
@@ -1274,7 +1414,6 @@ class AsyncTrainer:
         self._fault_injector = injector
 
         partitions = list(range(self.n_global_workers))
-        ledger = UnitLedger(epochs, partitions)
         worker_ids = [f"w{slot}" for slot in range(self.n_workers)]
         devices = self.devices
 
@@ -1309,22 +1448,38 @@ class AsyncTrainer:
                     )
                 return host_rows[part]
 
+        if self.batches_per_unit is not None:
+            # Batch-range units need every partition's batch count up
+            # front (the driver holds the dataset in-process here, so
+            # this just moves the lazy load earlier).
+            ledger = UnitLedger(
+                epochs, partitions,
+                n_batches={p: partition_rows(p)[2] for p in partitions},
+                batches_per_unit=self.batches_per_unit,
+            )
+        else:
+            ledger = UnitLedger(epochs, partitions)
+
         def run_unit(worker_id: str, client, unit):
-            # Each (epoch, partition) unit roots its own trace: the
+            # Each ledger unit roots its own trace: the
             # pull→train→push→PS-apply chain below — including a push
             # retried against a warm-restarted server — is one causal
             # tree (PS-side spans carry the boot id of the incarnation
             # that served them).
-            epoch, part = unit
+            epoch, part = unit[0], unit[1]
+            span_args = {}
+            if len(unit) > 2:
+                span_args["batches"] = f"{unit[2][0]}:{unit[2][1]}"
             tracer = obs.default_tracer()
             ctx = obs.new_context() if tracer.enabled else None
             with obs.activate(ctx), tracer.span(
                     "async/unit", epoch=epoch, partition=part,
-                    worker=worker_id) as usp:
+                    worker=worker_id, **span_args) as usp:
                 return unit_body(worker_id, client, unit, usp)
 
         def unit_body(worker_id: str, client, unit, usp=None):
-            epoch, part = unit
+            epoch, part = unit[0], unit[1]
+            batch_range = unit[2] if len(unit) > 2 else None
             device = device_for(worker_id)
             x, y, nb, usable = partition_rows(part)
             cache_key = (worker_id, part)
@@ -1344,6 +1499,14 @@ class AsyncTrainer:
             ey = jnp.take(y_d, perm_d, axis=0).reshape(
                 nb, batch_size, *y_d.shape[1:]
             )
+            # Batch-range unit: train only batches [lo, hi) of the
+            # SHARED (partition, epoch)-keyed shuffle, so the ranges of
+            # one epoch partition the identical batch stream a
+            # whole-partition unit would have trained — a survivor
+            # re-running a dead worker's range reproduces it exactly.
+            lo, hi = (0, nb) if batch_range is None else batch_range
+            if batch_range is not None:
+                ex, ey = ex[lo:hi], ey[lo:hi]
             pulled = client.get_parameters()
             params = jax.device_put(pulled["params"], device)
             batch_stats = jax.device_put(pulled["batch_stats"], device)
@@ -1355,12 +1518,16 @@ class AsyncTrainer:
             unit_rng = jax.random.fold_in(
                 jax.random.fold_in(self._base_rng, part), epoch
             )
+            if batch_range is not None:
+                # Distinct dropout stream per range (keyed on the range
+                # start, so it too is worker-independent).
+                unit_rng = jax.random.fold_in(unit_rng, lo)
             state0 = TrainState.create(
                 params=params,
                 opt_state=opt_state,
                 batch_stats=batch_stats,
                 rng=jax.device_put(unit_rng, device),
-                step=epoch * nb,
+                step=epoch * nb + lo,
             )
             with obs.default_tracer().span("async/train", worker=worker_id,
                                            epoch=epoch):
@@ -1372,12 +1539,22 @@ class AsyncTrainer:
                     k: float(v) for k, v in jax.device_get(metrics).items()
                 }
             delta_params = self._subtract(state0.params, new_state.params)
-            client.update_parameters({
-                "params": delta_params,
-                "batch_stats": self._subtract(
-                    state0.batch_stats, new_state.batch_stats
-                ),
-            })
+            try:
+                client.update_parameters({
+                    "params": delta_params,
+                    "batch_stats": self._subtract(
+                        state0.batch_stats, new_state.batch_stats
+                    ),
+                })
+            except StaleDeltaRejected as exc:
+                # The admission policy's definitive answer, NOT a worker
+                # fault: re-running this unit would train the identical
+                # batches against an even older base and push an even
+                # staler delta. Drop the delta, count the unit done —
+                # the next unit's pull refreshes this worker's base,
+                # which is exactly the re-pull the rejection demands.
+                if usp is not None:
+                    usp.note(admission="reject", lag=exc.lag)
             opt_states[worker_id] = new_state.opt_state
             # Unit dynamics: the scan is already forced (metrics fetch
             # above), so these host norms add one small transfer, not a
@@ -1566,7 +1743,10 @@ class AsyncTrainer:
             if self.pipelined_comms is not None
             else self.parameter_server_mode != "local"
         )
-        comms = _CommsPipeline(client, index, self.max_failures) if pipelined else None
+        comms = _CommsPipeline(
+            client, index, self.max_failures,
+            sync_interval=self.sync_interval,
+        ) if pipelined else None
         try:
             return self._run_worker_units(
                 index, device, client, comms, x, y, nb, usable,

@@ -47,6 +47,7 @@ RULE_NAMES = (
     "goodput_burn_high",
     "goodput_burn_critical",
     "canary_probe_failures",
+    "staleness_rejection_rate",
 )
 
 _PREDICATES = (">", "<")
@@ -121,6 +122,14 @@ def default_rules() -> List[AlertRule]:
         # Push retries per second (comms pipeline under partition/loss).
         AlertRule("push_retry_rate", "ps_push_retry_total",
                   ">", 0.5, kind="slo_breach", mode="rate",
+                  window_s=60.0, severity="warn", burn=2),
+        # Bounded-staleness admission refusing deltas at a sustained
+        # rate: an occasional rejection is the ratchet doing its job
+        # (the worker halves its push interval and recovers); a
+        # sustained rate means some worker can't catch up and its
+        # training work is being thrown away.
+        AlertRule("staleness_rejection_rate", "ps_delta_rejected_total",
+                  ">", 0.2, kind="delta_rejected", mode="rate",
                   window_s=60.0, severity="warn", burn=2),
         # Serving inter-token latency p99 (seconds).
         AlertRule("serving_itl_p99_high", "serving_itl_seconds_p99",

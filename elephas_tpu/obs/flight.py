@@ -71,6 +71,9 @@ KINDS = (
     # paged KV pool (serving/kv_pool.py): a resident prefix-cache entry
     # was LRU-evicted to free blocks under allocation pressure
     "prefix_evict",
+    # bounded-staleness admission (parameter/server.py): a pushed delta
+    # exceeded the hard max_staleness bound and was refused outright
+    "delta_rejected",
 )
 
 

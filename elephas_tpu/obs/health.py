@@ -64,22 +64,19 @@ class StalenessLedger:
         self._unstamped = 0
 
     def record(self, worker: Optional[str], lag: Optional[int],
-               nbytes: int = 0, version: Optional[int] = None) -> None:
+               nbytes: int = 0, version: Optional[int] = None,
+               sync_interval: Optional[float] = None) -> None:
         """One applied delta. ``lag=None`` means the frame carried no
-        ``seen_version`` stamp (legacy peer) — counted, not measured."""
+        ``seen_version`` stamp (legacy peer) — counted, not measured.
+        ``sync_interval``: the pusher's self-reported adaptive
+        units-per-push (last-write-wins; None when unstamped — the
+        fleet SYNC column renders '-' for those workers)."""
         now = self.clock()
         with self._lock:
             if lag is None:
                 self._unstamped += 1
                 return
-            key = str(worker) if worker is not None else "unknown"
-            row = self._workers.get(key)
-            if row is None:
-                row = self._workers[key] = {
-                    "updates": 0, "lag_sum": 0, "lag_max": 0,
-                    "bytes": 0, "last_seen_version": None,
-                    "last_seen_s": None,
-                }
+            row = self._row(worker)
             row["updates"] += 1
             row["lag_sum"] += int(lag)
             if lag > row["lag_max"]:
@@ -87,7 +84,36 @@ class StalenessLedger:
             row["bytes"] += int(nbytes)
             row["last_seen_version"] = version
             row["last_seen_s"] = now
+            if sync_interval is not None:
+                row["sync_interval"] = float(sync_interval)
             self._samples.append(int(lag))
+
+    def _row(self, worker: Optional[str]) -> Dict[str, Any]:
+        """Get-or-create a worker's row. Caller holds ``_lock``."""
+        key = str(worker) if worker is not None else "unknown"
+        row = self._workers.get(key)
+        if row is None:
+            row = self._workers[key] = {
+                "updates": 0, "lag_sum": 0, "lag_max": 0,
+                "bytes": 0, "last_seen_version": None,
+                "last_seen_s": None, "rejected": 0, "damped": 0,
+                "sync_interval": None,
+            }
+        return row
+
+    def record_rejected(self, worker: Optional[str]) -> None:
+        """One delta refused by the admission policy (hard bound).
+        Rejected pushes do NOT count as updates — the ledger's
+        ``updates`` column keeps meaning "deltas applied"."""
+        with self._lock:
+            self._row(worker)["rejected"] += 1
+
+    def record_damped(self, worker: Optional[str]) -> None:
+        """One delta applied at reduced weight (soft bound). The push
+        still counts as an update (``record`` ran for it); this column
+        just marks how many of them were decayed."""
+        with self._lock:
+            self._row(worker)["damped"] += 1
 
     def samples(self) -> list:
         """The retained lag window, arrival order (read-out paths build
@@ -141,14 +167,16 @@ def staleness_histogram(registry):
 def record_staleness(ledger: Optional[StalenessLedger],
                      worker: Optional[str], lag: Optional[int],
                      nbytes: int = 0, version: Optional[int] = None,
-                     registry=None) -> None:
+                     registry=None,
+                     sync_interval: Optional[float] = None) -> None:
     """The apply-site hook: ledger row + labeled histogram in one call.
 
     ``lag=None`` (unstamped legacy frame) still bumps the ledger's
     coverage counter but records no distribution point.
     """
     if ledger is not None:
-        ledger.record(worker, lag, nbytes=nbytes, version=version)
+        ledger.record(worker, lag, nbytes=nbytes, version=version,
+                      sync_interval=sync_interval)
     if lag is not None and registry is not None:
         staleness_histogram(registry).labels(
             worker=str(worker) if worker is not None else "unknown"

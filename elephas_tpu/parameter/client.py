@@ -92,18 +92,20 @@ def _resolve_codec(codec: Optional[str]) -> str:
 
 def _encode_push(delta, codec: str, quantize: Optional[str],
                  seen_version: Optional[int] = None,
-                 worker: Optional[str] = None):
+                 worker: Optional[str] = None,
+                 sync_interval: Optional[float] = None):
     """``(payload, codec_used)`` for one push. Structures the packed
     skeleton can't carry (custom pytree nodes) fall back to pickle —
     the server accepts either on one endpoint. ``seen_version``/
-    ``worker`` are the staleness stamps packed frames carry in-header
-    (pickle fallbacks lose them; the HTTP transport re-adds them as
-    request headers)."""
+    ``worker``/``sync_interval`` are the staleness stamps packed frames
+    carry in-header (pickle fallbacks lose them; the HTTP transport
+    re-adds them as request headers)."""
     if codec == "packed":
         try:
             return wire.encode_tree(delta, quantize=quantize,
                                     seen_version=seen_version,
-                                    worker=worker), "packed"
+                                    worker=worker,
+                                    sync_interval=sync_interval), "packed"
         except wire.WireFormatError:
             pass
     return wire.encode_pickle(delta), "pickle"
@@ -166,6 +168,42 @@ class _PullCache:
 
 class ParameterServerUnavailable(ConnectionError):
     """The parameter server could not be reached after retries."""
+
+
+class StaleDeltaRejected(RuntimeError):
+    """The PS refused a pushed delta: staler than its admission bound.
+
+    A *definitive* application-level answer, not a transport failure —
+    re-sending the same delta can only be MORE stale, so nothing retries
+    this. The right response (``async_engine._CommsPipeline`` implements
+    it) is to drop the delta, re-pull fresh parameters, and sync more
+    often. Carries the server's live ``version`` (the re-pull target),
+    the measured ``lag``, and the ``max_staleness`` bound it crossed."""
+
+    def __init__(self, address: str, version: int, lag: int,
+                 max_staleness: int):
+        self.address = address
+        self.version = int(version)
+        self.lag = int(lag)
+        self.max_staleness = int(max_staleness)
+        super().__init__(
+            f"parameter server at {address} rejected the pushed delta: "
+            f"staleness {lag} exceeds max_staleness={max_staleness} "
+            f"(server now at version {version}; re-pull and sync more "
+            "often)"
+        )
+
+
+def _raise_if_rejected(reply, address: str) -> None:
+    """Surface a typed ``EPRJ`` push reply as ``StaleDeltaRejected``.
+    Any other reply (the legacy ``b"ok"`` ack, an empty HTTP body)
+    passes through untouched."""
+    if isinstance(reply, (bytes, bytearray, memoryview)) \
+            and wire.is_packed(reply):
+        out = wire.decode(reply)
+        if isinstance(out, wire.DeltaRejected):
+            raise StaleDeltaRejected(address, out.version, out.lag,
+                                     out.max_staleness)
 
 
 def _retry_connect(fn, address: str, op: str, sleep=time.sleep):
@@ -307,6 +345,10 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
         # staleness ledger; owners (the elastic pool's client factory)
         # set it after construction. None → pushes go unstamped.
         self.worker_id: Optional[str] = None
+        # Self-reported adaptive units-per-push (the comms pipeline's
+        # ratchet keeps it current) — telemetry for the PS ledger's
+        # SYNC column, never part of the admission decision.
+        self.sync_interval: Optional[float] = None
 
     def _connect_once(self, transfer_timeout: Optional[float] = None) -> http.client.HTTPConnection:
         conn = http.client.HTTPConnection(*self._addr, timeout=_CONNECT_TIMEOUT)
@@ -432,7 +474,8 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
             payload, codec = _encode_push(delta, self.codec,
                                           self.push_quantize,
                                           seen_version=seen,
-                                          worker=self.worker_id)
+                                          worker=self.worker_id,
+                                          sync_interval=self.sync_interval)
             if isinstance(payload, wire.Frames):
                 # http.client needs one body buffer; the zero-copy chunk
                 # path is the socket transport's.
@@ -451,8 +494,14 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
                 headers["X-Elephas-Seen-Version"] = str(seen)
             if self.worker_id is not None:
                 headers["X-Elephas-Worker"] = str(self.worker_id)
-            self._post("/update", payload, "update_parameters",
-                       headers=headers or None)
+            if self.sync_interval is not None:
+                headers["X-Elephas-Sync-Interval"] = str(self.sync_interval)
+            body = self._post("/update", payload, "update_parameters",
+                              headers=headers or None)
+            # An admission rejection comes back as a typed frame in the
+            # (normally empty) 200 body — surface it as the exception
+            # the comms pipeline's ratchet acts on.
+            _raise_if_rejected(body, self.master_url)
 
     def health(self) -> bool:
         """One non-retried probe of ``GET /health``, bounded end-to-end by
@@ -534,6 +583,8 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
         self._pull_cache = _PullCache()
         # See HttpClient.worker_id: staleness-ledger identity stamp.
         self.worker_id: Optional[str] = None
+        # See HttpClient.sync_interval: SYNC-column telemetry stamp.
+        self.sync_interval: Optional[float] = None
         self._sock = None
         self._lock = threading.Lock()  # one in-flight request per connection
 
@@ -650,12 +701,17 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
                     frames = wire.encode_tree(
                         delta, quantize=self.push_quantize, trace=tc,
                         seen_version=self._pull_cache.known_version(),
-                        worker=self.worker_id)
+                        worker=self.worker_id,
+                        sync_interval=self.sync_interval)
                     frame, codec, nbytes = frames, "packed", frames.nbytes
                 except wire.WireFormatError:
                     pass
             with self._lock:
-                self._roundtrip(frame, "update_parameters", idempotent=False)
+                reply = self._roundtrip(frame, "update_parameters",
+                                        idempotent=False)
+            # The ack is b"ok" — unless the admission policy refused the
+            # delta, in which case the reply IS the typed EPRJ frame.
+            _raise_if_rejected(reply, self.master_url)
             if sp:
                 sp.note(codec=codec, payload_bytes=nbytes,
                         quantize=self.push_quantize)

@@ -362,6 +362,7 @@ class ShardedParameterClient(BaseParameterClient):
         self._codec = codec
         self._push_quantize = push_quantize
         self._worker_id: Optional[str] = None
+        self._sync_interval: Optional[float] = None
         self._clients: Dict[int, BaseParameterClient] = {}
         self._client_gen = -1
         self._lock = threading.Lock()
@@ -380,6 +381,20 @@ class ShardedParameterClient(BaseParameterClient):
         with self._lock:
             for client in self._clients.values():
                 client.worker_id = value
+
+    # Same post-construction propagation for the SYNC-column stamp: the
+    # comms pipeline updates it on the pool client, every sub-client's
+    # next push carries it.
+    @property
+    def sync_interval(self) -> Optional[float]:
+        return self._sync_interval
+
+    @sync_interval.setter
+    def sync_interval(self, value: Optional[float]) -> None:
+        self._sync_interval = value
+        with self._lock:
+            for client in self._clients.values():
+                client.sync_interval = value
 
     @property
     def plan(self) -> ShardPlan:
@@ -435,6 +450,7 @@ class ShardedParameterClient(BaseParameterClient):
                     codec=self._codec, push_quantize=self._push_quantize,
                 )
                 client.worker_id = self._worker_id
+                client.sync_interval = self._sync_interval
                 try:
                     self._verify(shard, client, address)
                 except Exception:
@@ -481,6 +497,12 @@ class ShardedParameterClient(BaseParameterClient):
         return self._plan.merge(subs)
 
     def update_parameters(self, delta) -> None:
+        # Admission is per shard: each member judges its slice against
+        # its own version line, so a StaleDeltaRejected from any shard
+        # propagates (first exception wins) while fresher shards may
+        # already have applied theirs — sound for SGD (a partial delta
+        # is just a smaller step) and the client's re-pull resyncs all
+        # K sub-caches anyway.
         parts = self._plan.split(delta)
         with obs.default_tracer().span("ps/scatter", shards=self._plan.k):
             self._fanout(lambda s, c: c.update_parameters(parts[s]))
@@ -619,7 +641,9 @@ class ShardGroup(BaseParameterServer):
                  ops_port: Optional[int] = None,
                  suspect_after: float = 0.5,
                  clock=time.monotonic, sleep=time.sleep,
-                 stream_poll_interval: float = 0.05):
+                 stream_poll_interval: float = 0.05,
+                 max_staleness: Optional[int] = None,
+                 staleness_soft: Optional[int] = None):
         if mode not in ("http", "socket"):
             raise ValueError(
                 "a PS group needs a wire transport (http|socket): shards "
@@ -667,6 +691,11 @@ class ShardGroup(BaseParameterServer):
                 role=role,
                 shard_info={"digest": self.plan.digest, "shard": shard,
                             "k": k},
+                # Every member enforces the same staleness bounds: a
+                # sharded push is admitted (or refused) per shard against
+                # that shard's own version line.
+                max_staleness=max_staleness,
+                staleness_soft=staleness_soft,
             )
 
         def ops_at(offset: int) -> Optional[int]:

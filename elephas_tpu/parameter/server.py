@@ -68,27 +68,36 @@ def _ps_counters(transport: str):
     )
 
 
-def _note_staleness(ledger, worker, seen_version, nbytes, buffer) -> Optional[int]:
-    """Measure one push's version lag and feed the health surfaces.
+def _lag_of(buffer, seen_version) -> Optional[int]:
+    """One push's version lag: the buffer's live version minus the
+    version the worker trained against (clamped at 0 — a racing hogwild
+    apply can only make the live version newer). None for frames
+    without a ``seen_version`` stamp (legacy peers)."""
+    if seen_version is None:
+        return None
+    try:
+        return max(0, int(buffer.version) - int(seen_version))
+    except (TypeError, ValueError):
+        return None
 
-    Called immediately BEFORE ``apply_delta``: lag = the buffer's live
-    version minus the version the worker trained against (clamped at 0 —
-    a racing hogwild apply can only make the live version newer). Frames
-    without a ``seen_version`` stamp (legacy peers) are counted as
-    unstamped coverage, not measured. Returns the lag (None when
-    unstamped) so handle spans can tag it."""
-    lag = None
-    if seen_version is not None:
-        try:
-            lag = max(0, int(buffer.version) - int(seen_version))
-        except (TypeError, ValueError):
-            lag = None
+
+def _note_staleness(ledger, worker, lag, seen_version, nbytes,
+                    sync_interval=None) -> None:
+    """Feed one APPLIED push into the health surfaces (ledger row +
+    labeled histogram). Called after the admission decision, for
+    accepted/damped pushes only — a rejected push was never applied, so
+    it must not count as an update or skew the lag distribution (the
+    ledger's ``rejected`` column, bumped by ``_admit``, is its record).
+    ``lag=None`` (unstamped legacy frame) counts as unstamped coverage.
+    ``sync_interval`` is the pusher's self-reported adaptive
+    units-per-push (None when it doesn't stamp one) — kept on the
+    worker's ledger row for the fleet SYNC column."""
     from elephas_tpu.obs.health import record_staleness
 
     record_staleness(ledger, worker, lag, nbytes=nbytes,
                      version=seen_version,
-                     registry=obs.default_registry())
-    return lag
+                     registry=obs.default_registry(),
+                     sync_interval=sync_interval)
 
 
 def _parse_trace_header(raw: Optional[str]):
@@ -144,6 +153,126 @@ def _heartbeat_timeout(explicit: Optional[float] = None) -> float:
             stacklevel=2,
         )
         return 5.0
+
+
+def _staleness_bound(explicit, env_var: str) -> Optional[int]:
+    """Optional staleness bound, versions. Precedence mirrors
+    ``_heartbeat_timeout``: explicit argument > env var > None
+    (unbounded). A malformed env value warns and falls back rather than
+    crashing server construction."""
+    if explicit is not None:
+        return int(explicit)
+    raw = os.environ.get(env_var)
+    if raw is None or raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        warnings.warn(
+            f"{env_var}={raw!r} is not an integer; staleness bound "
+            "disabled",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return None
+
+
+class AdmissionPolicy:
+    """Accept / damp / reject one stamped delta by its version lag.
+
+    The enforcement half of the staleness plane: ``StalenessLedger``
+    measures every push's version lag; this policy acts on it at the
+    apply site. Three regimes, per delta:
+
+    - ``lag <= soft`` (or no bounds set): **accept** at full weight.
+    - ``soft < lag <= max_staleness``: **damp** — the delta is applied
+      scaled by ``1 / (1 + lag - soft)``, the DeepSpark-style staleness
+      decay (arXiv 1602.08191): a slightly-stale gradient still carries
+      signal, a very stale one mostly noise.
+    - ``lag > max_staleness``: **reject** — the delta is not applied at
+      all and the pusher gets a typed ``wire.encode_rejected`` frame
+      telling it to re-pull and sync more often.
+
+    Unstamped pushes (legacy peers that declare no ``seen_version``)
+    have ``lag=None`` and are ALWAYS accepted at full weight — bounds
+    only bind peers that opted into the staleness contract, so old
+    pickle workers keep their exact pre-policy behavior.
+
+    Bounds resolve like every other server knob: explicit constructor
+    argument > ``ELEPHAS_MAX_STALENESS`` / ``ELEPHAS_STALENESS_SOFT``
+    env vars > None (unbounded / no damping).
+    """
+
+    def __init__(self, max_staleness: Optional[int] = None,
+                 soft: Optional[int] = None):
+        self.max_staleness = _staleness_bound(
+            max_staleness, "ELEPHAS_MAX_STALENESS")
+        self.soft = _staleness_bound(soft, "ELEPHAS_STALENESS_SOFT")
+
+    def decide(self, lag: Optional[int]):
+        """``(verdict, weight)``: ``("accept", 1.0)``, ``("damp", w<1)``,
+        or ``("reject", 0.0)``."""
+        if lag is None:
+            return "accept", 1.0
+        if self.max_staleness is not None and lag > self.max_staleness:
+            return "reject", 0.0
+        if self.soft is not None and lag > self.soft:
+            return "damp", 1.0 / (1.0 + (lag - self.soft))
+        return "accept", 1.0
+
+    def __repr__(self):
+        return (f"AdmissionPolicy(max_staleness={self.max_staleness}, "
+                f"soft={self.soft})")
+
+
+def _scale_tree(tree, weight: float):
+    """Scale a delta's float leaves by the damping weight. Decoded wire
+    leaves are read-only ``frombuffer`` views, so the multiply's copy is
+    the first (and only) host copy a damped apply pays. Non-float leaves
+    (step counters and the like) pass through unscaled — a fractional
+    counter increment is meaningless."""
+    import numpy as np
+
+    def scale(leaf):
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f":
+            return arr * arr.dtype.type(weight)
+        return leaf
+
+    return jax.tree_util.tree_map(scale, tree)
+
+
+def _admit(policy, ledger, worker, lag, tree, transport: str, hsp=None):
+    """Run one stamped push through the admission policy.
+
+    Returns ``(verdict, tree)`` — the tree scaled for a damp, ``None``
+    for a reject (the caller answers with the typed frame and skips
+    apply + WAL). Feeds the counters, the per-worker ledger columns,
+    and — on a reject — the flight recorder, so every non-accept
+    decision is visible on all three obs surfaces."""
+    verdict, weight = policy.decide(lag)
+    if verdict == "accept":
+        return verdict, tree
+    reg = obs.default_registry()
+    if verdict == "damp":
+        reg.counter("ps_delta_damped_total",
+                    "stamped deltas applied at reduced weight by the "
+                    "staleness admission policy").inc()
+        ledger.record_damped(worker)
+        if hsp:
+            hsp.note(admission="damp", weight=round(weight, 4))
+        return verdict, _scale_tree(tree, weight)
+    reg.counter("ps_delta_rejected_total",
+                "stamped deltas refused by the staleness admission policy",
+                labelnames=("reason",)).labels(reason="max_staleness").inc()
+    ledger.record_rejected(worker)
+    obs.default_flight_recorder().note(
+        "delta_rejected", "warn", worker=worker, lag=lag,
+        max_staleness=policy.max_staleness, transport=transport,
+    )
+    if hsp:
+        hsp.note(admission="reject", lag=lag)
+    return verdict, None
 
 
 def _make_detector(heartbeat_timeout: Optional[float]):
@@ -399,6 +528,8 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         ops_port: Optional[int] = None,
         role: str = "ps",
         shard_info: Optional[dict] = None,
+        max_staleness: Optional[int] = None,
+        staleness_soft: Optional[int] = None,
     ):
         """``auth_key``: shared HMAC-SHA256 secret. When set, every
         request must carry ``X-Elephas-Auth`` = hexmac(method + path +
@@ -431,7 +562,10 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         ``ps/shard<i>`` / ``ps/standby`` inside a group). ``shard_info``:
         the group handshake doc (``{digest, shard, k}``) served from
         ``GET /shardinfo`` with the live boot id merged in — unset means
-        the route 404s and sharded clients refuse this server."""
+        the route 404s and sharded clients refuse this server.
+        ``max_staleness``/``staleness_soft``: the bounded-staleness
+        admission knobs (see ``AdmissionPolicy``; env fallbacks
+        ``ELEPHAS_MAX_STALENESS``/``ELEPHAS_STALENESS_SOFT``)."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
@@ -452,6 +586,7 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         # engine evaluated on every /alerts scrape.
         self.ledger = obs.StalenessLedger()
         self.alerts = obs.AlertEngine()
+        self.admission = AdmissionPolicy(max_staleness, staleness_soft)
         self.role = role
         self.shard_info = shard_info
         self.flight_dump: Optional[str] = None
@@ -471,6 +606,7 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         cache_hits, bytes_tx, bytes_rx = _ps_counters("http")
         tracer_of = self._tracer
         ledger = self.ledger
+        admission = self.admission
         shard_info = self.shard_info
 
         class Handler(BaseHTTPRequestHandler):
@@ -610,7 +746,8 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
                     # Trace context: the HTTP header, or (packed bodies)
                     # the frame's own "tc" header. Decoding is zero-copy,
                     # so doing it before the handle span costs ~nothing.
-                    tree, body_tc, seen, worker = wire.decode_push(body)
+                    tree, body_tc, seen, worker, syncint = \
+                        wire.decode_push(body)
                     # Pickle bodies carry their staleness stamps as
                     # request headers instead of in-frame.
                     if seen is None:
@@ -622,6 +759,13 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
                                 seen = None
                     if worker is None:
                         worker = self.headers.get("X-Elephas-Worker")
+                    if syncint is None:
+                        raw_si = self.headers.get("X-Elephas-Sync-Interval")
+                        if raw_si is not None:
+                            try:
+                                syncint = float(raw_si)
+                            except ValueError:
+                                syncint = None
                     ctx = (_parse_trace_header(
                                self.headers.get("X-Elephas-Trace"))
                            or _as_trace_ctx(body_tc))
@@ -629,10 +773,33 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
                     with obs.activate(ctx), tracer.span(
                             "ps/handle_push", boot=boot,
                             transport="http") as hsp:
-                        lag = _note_staleness(ledger, worker, seen,
-                                              len(body), buffer)
+                        lag = _lag_of(buffer, seen)
                         if hsp and lag is not None:
                             hsp.note(staleness=lag, worker=worker)
+                        verdict, tree = _admit(admission, ledger, worker,
+                                               lag, tree, "http", hsp)
+                        if verdict != "reject":
+                            # Ledger update only for applied pushes —
+                            # a reject is recorded by _admit as
+                            # ``rejected`` and must not count as an
+                            # update or enter the lag histogram.
+                            _note_staleness(ledger, worker, lag, seen,
+                                            len(body),
+                                            sync_interval=syncint)
+                        if verdict == "reject":
+                            # Typed refusal instead of an apply: the
+                            # stamped peer decodes this into a
+                            # StaleDeltaRejected. Unstamped legacy
+                            # pushes can never reach here (lag None
+                            # always accepts).
+                            frame = wire.encode_rejected(
+                                buffer.version, lag,
+                                admission.max_staleness)
+                            bytes_tx.inc(frame.nbytes)
+                            self._reply(
+                                frame,
+                                content_type="application/octet-stream")
+                            return
                         with tracer.span("ps/apply", boot=boot):
                             # The buffer-lock + apply + WAL durability
                             # window — the "lock" phase in the per-unit
@@ -720,6 +887,7 @@ class _SocketHandler(socketserver.BaseRequestHandler):
         wal_writer = self.server.wal_writer  # type: ignore[attr-defined]
         tracer_of = self.server.tracer_of  # type: ignore[attr-defined]
         ledger = self.server.ledger  # type: ignore[attr-defined]
+        admission = self.server.admission  # type: ignore[attr-defined]
         shard_info = self.server.shard_info  # type: ignore[attr-defined]
         cache_hits, bytes_tx, bytes_rx = _ps_counters("socket")
         try:
@@ -750,20 +918,35 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                 if isinstance(obj, (bytes, bytearray, memoryview)):
                     mv = memoryview(obj)
                     bytes_rx.inc(mv.nbytes)
-                    tree, tc, seen, worker = wire.decode_push(mv)
+                    tree, tc, seen, worker, syncint = wire.decode_push(mv)
                     tracer = tracer_of()
+                    rejected_frame = None
                     with obs.activate(_as_trace_ctx(tc)), tracer.span(
                             "ps/handle_push", boot=boot,
                             transport="socket") as hsp:
-                        lag = _note_staleness(ledger, worker, seen,
-                                              mv.nbytes, buffer)
+                        lag = _lag_of(buffer, seen)
                         if hsp and lag is not None:
                             hsp.note(staleness=lag, worker=worker)
-                        with tracer.span("ps/apply", boot=boot):
-                            buffer.apply_delta(tree)
-                            if wal_writer is not None:
-                                wal_writer.after_update()  # durable pre-ack
-                    reply(b"ok")
+                        verdict, tree = _admit(admission, ledger, worker,
+                                               lag, tree, "socket", hsp)
+                        if verdict != "reject":
+                            # Applied pushes only — see the HTTP path.
+                            _note_staleness(ledger, worker, lag, seen,
+                                            mv.nbytes,
+                                            sync_interval=syncint)
+                        if verdict == "reject":
+                            # Typed refusal (only ever sent to stamped
+                            # peers — legacy pushes always accept).
+                            rejected_frame = wire.encode_rejected(
+                                buffer.version, lag,
+                                admission.max_staleness)
+                        else:
+                            with tracer.span("ps/apply", boot=boot):
+                                buffer.apply_delta(tree)
+                                if wal_writer is not None:
+                                    wal_writer.after_update()  # durable pre-ack
+                    reply(rejected_frame if rejected_frame is not None
+                          else b"ok")
                     continue
 
                 # Frames are (kind, payload) from legacy peers or
@@ -800,7 +983,7 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                             "ps/handle_push", boot=boot, transport="socket"):
                         # Legacy pickle frame: no staleness stamps — the
                         # ledger counts it as unstamped coverage.
-                        _note_staleness(ledger, None, None, 0, buffer)
+                        _note_staleness(ledger, None, None, None, 0)
                         with tracer.span("ps/apply", boot=boot):
                             buffer.apply_delta(payload)
                             if wal_writer is not None:
@@ -897,16 +1080,19 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         ops_port: Optional[int] = None,
         role: str = "ps",
         shard_info: Optional[dict] = None,
+        max_staleness: Optional[int] = None,
+        staleness_soft: Optional[int] = None,
     ):
         """``auth_key``: shared HMAC-SHA256 secret — every frame in both
         directions carries a tag (nonce+timestamp under the MAC) verified
         before unpickling, and the server rejects replayed/stale nonces
         (see ``utils.sockets.send/receive``/``ReplayGuard``).
         ``wal_dir``/``wal_every``/``heartbeat_timeout``/``tracer``/
-        ``ops_port``/``role``/``shard_info``: see ``HttpServer`` —
-        identical durability, liveness, observability, and shard-group
-        handshake semantics (here the handshake is the ``('i', None)``
-        frame)."""
+        ``ops_port``/``role``/``shard_info``/``max_staleness``/
+        ``staleness_soft``: see ``HttpServer`` — identical durability,
+        liveness, observability, shard-group handshake, and staleness
+        admission semantics (here the rejection reply is the raw
+        ``EPRJ`` frame in place of the ``b"ok"`` ack)."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
@@ -922,9 +1108,10 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         self.tracer = tracer
         self.ops_port = ops_port
         self.ops = None
-        # See HttpServer: staleness ledger + SLO alert engine.
+        # See HttpServer: staleness ledger + SLO alert engine + admission.
         self.ledger = obs.StalenessLedger()
         self.alerts = obs.AlertEngine()
+        self.admission = AdmissionPolicy(max_staleness, staleness_soft)
         self.role = role
         self.shard_info = shard_info
         self.flight_dump: Optional[str] = None
@@ -944,6 +1131,7 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         self._server.wal_writer = self.wal_writer  # type: ignore[attr-defined]
         self._server.tracer_of = self._tracer  # type: ignore[attr-defined]
         self._server.ledger = self.ledger  # type: ignore[attr-defined]
+        self._server.admission = self.admission  # type: ignore[attr-defined]
         self._server.shard_info = self.shard_info  # type: ignore[attr-defined]
         if self.port == 0:
             self.port = self._server.server_address[1]
@@ -1002,6 +1190,8 @@ def make_server(
     ops_port: Optional[int] = None,
     role: str = "ps",
     shard_info: Optional[dict] = None,
+    max_staleness: Optional[int] = None,
+    staleness_soft: Optional[int] = None,
 ) -> BaseParameterServer:
     """Factory keyed on the reference's ``parameter_server_mode``.
     ``granularity`` ('tree'|'leaf') sets the hogwild apply isolation —
@@ -1015,7 +1205,10 @@ def make_server(
     transports; the local server shares the workers' process-global
     tracer already). ``role``/``shard_info``: the fleet role stamp and
     shard-group handshake doc (``parameter.group`` passes these; a
-    standalone server keeps the defaults)."""
+    standalone server keeps the defaults).
+    ``max_staleness``/``staleness_soft``: bounded-staleness admission
+    (wire transports only — a local client applies in-process under the
+    buffer lock, so its deltas are never stale)."""
     if mode == "local":
         if wal_dir is not None:
             raise ValueError(
@@ -1028,6 +1221,12 @@ def make_server(
                 "shard_info requires a wire transport (http|socket): shard "
                 "group members are separate server processes"
             )
+        if max_staleness is not None or staleness_soft is not None:
+            raise ValueError(
+                "staleness admission requires a wire transport "
+                "(http|socket): local pushes apply under the buffer lock "
+                "and are never stale"
+            )
         return LocalServer(params, lock=lock, device=device, granularity=granularity,
                            heartbeat_timeout=heartbeat_timeout)
     if mode == "http":
@@ -1036,12 +1235,16 @@ def make_server(
                           wal_dir=wal_dir, wal_every=wal_every,
                           heartbeat_timeout=heartbeat_timeout,
                           tracer=tracer, ops_port=ops_port,
-                          role=role, shard_info=shard_info)
+                          role=role, shard_info=shard_info,
+                          max_staleness=max_staleness,
+                          staleness_soft=staleness_soft)
     if mode == "socket":
         return SocketServer(params, lock=lock, port=port, device=device, host=host,
                             granularity=granularity, auth_key=auth_key,
                             wal_dir=wal_dir, wal_every=wal_every,
                             heartbeat_timeout=heartbeat_timeout,
                             tracer=tracer, ops_port=ops_port,
-                            role=role, shard_info=shard_info)
+                            role=role, shard_info=shard_info,
+                            max_staleness=max_staleness,
+                            staleness_soft=staleness_soft)
     raise ValueError(f"parameter_server_mode must be local|http|socket, got {mode!r}")

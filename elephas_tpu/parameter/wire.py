@@ -51,10 +51,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from elephas_tpu.utils.sockets import MAGIC_NOTMOD, MAGIC_TREE, RawPayload
+from elephas_tpu.utils.sockets import (MAGIC_NOTMOD, MAGIC_REJECT,
+                                       MAGIC_TREE, RawPayload)
 
 __all__ = [
     "DecodedTree",
+    "DeltaRejected",
     "Frames",
     "NotModified",
     "WireFormatError",
@@ -65,6 +67,7 @@ __all__ = [
     "decode_push",
     "encode_not_modified",
     "encode_pickle",
+    "encode_rejected",
     "encode_tree",
     "is_packed",
 ]
@@ -112,6 +115,29 @@ class NotModified:
         return f"NotModified(version={self.version})"
 
 
+class DeltaRejected:
+    """Decoded ``EPRJ`` frame: the server refused to apply a pushed
+    delta because it was staler than the admission policy's hard bound.
+
+    ``version`` is the server's live buffer version at rejection time —
+    the client should re-pull before retraining, and the frame carries
+    the target so a worker can tell how far behind it fell. ``lag`` is
+    the measured staleness (live version minus the push's
+    ``seen_version``) and ``max_staleness`` the bound it crossed, so the
+    surfaced exception's message is self-diagnosing."""
+
+    __slots__ = ("version", "lag", "max_staleness")
+
+    def __init__(self, version: int, lag: int, max_staleness: int):
+        self.version = version
+        self.lag = lag
+        self.max_staleness = max_staleness
+
+    def __repr__(self):
+        return (f"DeltaRejected(version={self.version}, lag={self.lag}, "
+                f"max_staleness={self.max_staleness})")
+
+
 class DecodedTree:
     """Decoded ``EPK1`` frame: ``tree`` (zero-copy leaves) + ``version``
     (+ the serving server's ``boot`` id, when it sent one).
@@ -129,27 +155,35 @@ class DecodedTree:
     ``seen_version``/``worker`` (training-health layer): on a *push*
     frame, the buffer version the worker trained its delta against and
     the worker's stable id — the PS's staleness accounting subtracts
-    ``seen_version`` from its live version at apply time. Both optional,
-    both absent from the header JSON when the sender didn't stamp them."""
+    ``seen_version`` from its live version at apply time. Optional,
+    absent from the header JSON when the sender didn't stamp them.
 
-    __slots__ = ("tree", "version", "boot", "trace", "seen_version", "worker")
+    ``sync_interval`` (admission layer): the pusher's current adaptive
+    units-per-push, self-reported so the PS ledger (and the fleet SYNC
+    column) can show each worker's effective sync cadence. Same
+    omitted-when-None contract."""
+
+    __slots__ = ("tree", "version", "boot", "trace", "seen_version",
+                 "worker", "sync_interval")
 
     def __init__(self, tree, version: Optional[int], boot: Optional[str] = None,
                  trace: Optional[Tuple[str, str]] = None,
                  seen_version: Optional[int] = None,
-                 worker: Optional[str] = None):
+                 worker: Optional[str] = None,
+                 sync_interval: Optional[float] = None):
         self.tree = tree
         self.version = version
         self.boot = boot
         self.trace = trace
         self.seen_version = seen_version
         self.worker = worker
+        self.sync_interval = sync_interval
 
 
 def is_packed(buf) -> bool:
     """True iff ``buf`` starts with a packed-codec magic."""
     head = bytes(memoryview(buf)[:4])
-    return head == MAGIC_TREE or head == MAGIC_NOTMOD
+    return head == MAGIC_TREE or head == MAGIC_NOTMOD or head == MAGIC_REJECT
 
 
 # -- structure skeleton -------------------------------------------------------
@@ -247,7 +281,8 @@ def encode_tree(tree, version: Optional[int] = None,
                 boot: Optional[str] = None,
                 trace: Optional[Tuple[str, str]] = None,
                 seen_version: Optional[int] = None,
-                worker: Optional[str] = None) -> Frames:
+                worker: Optional[str] = None,
+                sync_interval: Optional[float] = None) -> Frames:
     """Encode a pytree of arrays/scalars into a packed frame.
 
     ``boot``: the serving PS's boot id, carried in the header so clients
@@ -266,6 +301,10 @@ def encode_tree(tree, version: Optional[int] = None,
     ``"sv"``/``"wk"`` under the same omitted-when-None contract — the PS
     measures version lag only on frames that declare what they trained
     against, and legacy frames stay byte-identical.
+
+    ``sync_interval``: the pusher's adaptive units-per-push, carried as
+    ``"si"`` under the same contract — pure telemetry for the PS
+    ledger's SYNC column, never part of the admission decision.
     """
     leaves: List[Any] = []
     skeleton = _build_skeleton(tree, leaves)
@@ -300,6 +339,8 @@ def encode_tree(tree, version: Optional[int] = None,
         meta["sv"] = int(seen_version)
     if worker is not None:
         meta["wk"] = str(worker)
+    if sync_interval is not None:
+        meta["si"] = float(sync_interval)
     header = json.dumps(meta, separators=(",", ":")).encode()
     # Pad the header with spaces (JSON-transparent) so the payload
     # region starts 64B-aligned relative to the frame start.
@@ -311,6 +352,16 @@ def encode_tree(tree, version: Optional[int] = None,
 def encode_not_modified(version: int) -> Frames:
     """The 12-byte "your snapshot is current" reply frame."""
     return Frames([MAGIC_NOTMOD + _U64.pack(int(version))])
+
+
+def encode_rejected(version: int, lag: int, max_staleness: int) -> Frames:
+    """The 28-byte "delta too stale, re-pull" push reply frame.
+
+    Only emitted to peers that *stamped* their push (packed frames with
+    ``sv``, or pickle bodies under staleness headers) — an unstamped
+    legacy peer never sees this magic, preserving its old contract."""
+    return Frames([MAGIC_REJECT + _U64.pack(int(version))
+                   + _U64.pack(int(lag)) + _U64.pack(int(max_staleness))])
 
 
 def encode_pickle(obj) -> bytes:
@@ -346,6 +397,12 @@ def decode(buf, expect_treedef=None):
         if len(mv) < 4 + _U64.size:
             raise WireFormatError("truncated not-modified frame")
         return NotModified(_U64.unpack_from(mv, 4)[0])
+    if head == MAGIC_REJECT:
+        if len(mv) < 4 + 3 * _U64.size:
+            raise WireFormatError("truncated delta-rejected frame")
+        return DeltaRejected(_U64.unpack_from(mv, 4)[0],
+                             _U64.unpack_from(mv, 4 + _U64.size)[0],
+                             _U64.unpack_from(mv, 4 + 2 * _U64.size)[0])
     if head != MAGIC_TREE:
         raise WireFormatError(
             f"not a packed frame (magic {head!r}; legacy pickle bodies "
@@ -394,7 +451,8 @@ def decode(buf, expect_treedef=None):
     tc = header.get("tc")
     return DecodedTree(tree, header.get("ver"), header.get("boot"),
                        tuple(tc) if tc else None,
-                       header.get("sv"), header.get("wk"))
+                       header.get("sv"), header.get("wk"),
+                       header.get("si"))
 
 
 def decode_payload(buf, expect_treedef=None):
@@ -407,8 +465,9 @@ def decode_payload(buf, expect_treedef=None):
     """
     if is_packed(buf):
         out = decode(buf, expect_treedef=expect_treedef)
-        if isinstance(out, NotModified):
-            raise WireFormatError("not-modified frame where a tree was expected")
+        if isinstance(out, (NotModified, DeltaRejected)):
+            raise WireFormatError(
+                f"status frame {out!r} where a tree was expected")
         return out.tree
     return decode_pickle(buf)
 
@@ -421,8 +480,9 @@ def decode_payload_traced(buf, expect_treedef=None):
     does, upstream, via the 3-tuple socket shape)."""
     if is_packed(buf):
         out = decode(buf, expect_treedef=expect_treedef)
-        if isinstance(out, NotModified):
-            raise WireFormatError("not-modified frame where a tree was expected")
+        if isinstance(out, (NotModified, DeltaRejected)):
+            raise WireFormatError(
+                f"status frame {out!r} where a tree was expected")
         return out.tree, out.trace
     return decode_pickle(buf), None
 
@@ -430,11 +490,14 @@ def decode_payload_traced(buf, expect_treedef=None):
 def decode_push(buf, expect_treedef=None):
     """``decode_payload`` for the PS push handlers: surfaces the sender's
     trace context AND staleness stamps as ``(tree, trace, seen_version,
-    worker)``. Legacy pickle bodies decode with every stamp ``None`` —
-    staleness simply isn't measured for peers that don't declare it."""
+    worker, sync_interval)``. Legacy pickle bodies decode with every
+    stamp ``None`` — staleness simply isn't measured for peers that
+    don't declare it."""
     if is_packed(buf):
         out = decode(buf, expect_treedef=expect_treedef)
-        if isinstance(out, NotModified):
-            raise WireFormatError("not-modified frame where a tree was expected")
-        return out.tree, out.trace, out.seen_version, out.worker
-    return decode_pickle(buf), None, None, None
+        if isinstance(out, (NotModified, DeltaRejected)):
+            raise WireFormatError(
+                f"status frame {out!r} where a tree was expected")
+        return (out.tree, out.trace, out.seen_version, out.worker,
+                out.sync_interval)
+    return decode_pickle(buf), None, None, None, None

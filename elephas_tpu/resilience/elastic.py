@@ -6,8 +6,11 @@ executor could join mid-stage (SURVEY.md §5.3). This module rebuilds
 exactly that contract for the thread-per-chip async trainer:
 
 - ``UnitLedger`` is the scheduler's task table: every frequency unit is
-  ``(epoch, partition)``, leased epoch-major to whichever worker asks
-  next. A dead worker's leases go back to the FRONT of the queue
+  ``(epoch, partition)`` — or, with ``batches_per_unit`` set,
+  ``(epoch, partition, (lo, hi))`` batch ranges so a death mid-epoch
+  re-leases only the unfinished ranges — leased epoch-major to
+  whichever worker asks next. A dead worker's leases go back to the
+  FRONT of the queue
   (earliest epochs first), and **each unit counts exactly once** — a
   zombie (a stalled worker that wakes after its lease was revoked and
   finished by a survivor) can deliver a duplicate completion and the
@@ -51,29 +54,76 @@ from elephas_tpu.parameter.client import ParameterServerUnavailable
 from elephas_tpu.resilience.faults import FaultInjector, InjectedWorkerDeath
 from elephas_tpu.resilience.liveness import MembershipView
 
-Unit = Tuple[int, int]  # (epoch, partition)
+#: A ledger unit is ``(epoch, partition)`` (whole-partition granularity)
+#: or ``(epoch, partition, (lo, hi))`` (a half-open batch range) — the
+#: first two slots are stable either way, so span tags and the pool's
+#: per-(epoch, partition) metric table index units identically.
+Unit = Tuple
 
 
 class UnitLedger:
-    """Exactly-once accounting over ``epochs × partitions`` units.
+    """Exactly-once accounting over the fit's frequency units.
 
     Thread-safe. Leases hand out pending units epoch-major (all of
     epoch e before any of e+1 — re-queued units from a death go back to
     the front in epoch order, so survivors repair the earliest hole
     first). ``complete`` is idempotent per unit: the first completion
     counts, anything later (zombie double-completion) is ignored.
+
+    Granularity: by default one unit per ``(epoch, partition)``. With
+    ``n_batches`` (per-partition batch counts) the ledger re-keys on
+    ``(epoch, partition, (lo, hi))`` batch ranges of ``batches_per_unit``
+    batches each (last range may be short) — so a death mid-epoch
+    re-leases only the UNFINISHED ranges to survivors instead of
+    re-running whole partitions. Epoch completion is counted against the
+    true per-epoch unit count (``units_per_epoch``), never against
+    ``len(partitions)`` — range completions arrive out of order across
+    partitions and an epoch is done only when every range of every
+    partition has counted exactly once.
     """
 
-    def __init__(self, epochs: int, partitions: List[int]):
+    def __init__(self, epochs: int, partitions: List[int],
+                 n_batches=None, batches_per_unit: Optional[int] = None):
         if epochs < 1 or not partitions:
             raise ValueError(
                 f"need >=1 epoch and >=1 partition, got {epochs}/{partitions}"
             )
         self.epochs = epochs
         self.partitions = list(partitions)
-        self._pending: deque = deque(
-            (e, p) for e in range(epochs) for p in self.partitions
-        )
+        if n_batches is None:
+            if batches_per_unit is not None:
+                raise ValueError(
+                    "batches_per_unit needs n_batches (per-partition "
+                    "batch counts) to cut ranges from"
+                )
+            self.ranges: Optional[Dict[int, List[Tuple[int, int]]]] = None
+            units = [(e, p) for e in range(epochs) for p in self.partitions]
+            self.units_per_epoch = len(self.partitions)
+        else:
+            if isinstance(n_batches, int):
+                n_batches = {p: n_batches for p in self.partitions}
+            ranges: Dict[int, List[Tuple[int, int]]] = {}
+            for p in self.partitions:
+                nb = int(n_batches[p])
+                if nb < 1:
+                    raise ValueError(
+                        f"partition {p}: need >=1 batch, got {nb}"
+                    )
+                step = nb if batches_per_unit is None \
+                    else max(1, int(batches_per_unit))
+                ranges[p] = [
+                    (lo, min(lo + step, nb)) for lo in range(0, nb, step)
+                ]
+            self.ranges = ranges
+            self.units_per_epoch = sum(len(r) for r in ranges.values())
+            units = [
+                (e, p, r)
+                for e in range(epochs)
+                for p in self.partitions
+                for r in ranges[p]
+            ]
+        self.batches_per_unit = batches_per_unit
+        self._pending: deque = deque(units)
         self._leased: Dict[Unit, str] = {}
         self._done: Dict[Unit, str] = {}
         self._epoch_done: List[int] = [0] * epochs
@@ -82,7 +132,7 @@ class UnitLedger:
 
     @property
     def total_units(self) -> int:
-        return self.epochs * len(self.partitions)
+        return self.epochs * self.units_per_epoch
 
     @property
     def completed_units(self) -> int:
@@ -125,7 +175,11 @@ class UnitLedger:
                 pass
             epoch = unit[0]
             self._epoch_done[epoch] += 1
-            finished = epoch if self._epoch_done[epoch] == len(self.partitions) \
+            # Compare against the TRUE per-epoch unit count: under
+            # batch-range keying there are more units per epoch than
+            # partitions, and completions land out of order — counting
+            # against len(partitions) would fire the epoch early.
+            finished = epoch if self._epoch_done[epoch] == self.units_per_epoch \
                 else None
             return True, finished
 
@@ -157,7 +211,7 @@ class UnitLedger:
 
     def epoch_complete(self, epoch: int) -> bool:
         with self._lock:
-            return self._epoch_done[epoch] == len(self.partitions)
+            return self._epoch_done[epoch] == self.units_per_epoch
 
 
 class _WorkerCtx:
@@ -226,6 +280,10 @@ class ElasticWorkerPool:
         # counted completion of such a unit closes the MTTR window.
         self._repairing: Dict[Unit, float] = {}
         self._epoch_metrics: Dict[int, Dict[int, Dict]] = {}
+        # How many units have folded into each (epoch, partition) metric
+        # slot — batch-range units running-mean into one row so the
+        # epoch_metrics() shape is granularity-independent.
+        self._metric_counts: Dict[Tuple[int, int], int] = {}
         self._monitor_thread: Optional[threading.Thread] = None
         for worker_id in worker_ids:
             self._ctxs[str(worker_id)] = _WorkerCtx(str(worker_id))
@@ -413,7 +471,23 @@ class ElasticWorkerPool:
                 counted, finished_epoch = self.ledger.complete(worker_id, unit)
                 if counted:
                     with self._lock:
-                        self._epoch_metrics.setdefault(unit[0], {})[unit[1]] = metrics
+                        slot = self._epoch_metrics.setdefault(unit[0], {})
+                        prev = slot.get(unit[1])
+                        n = self._metric_counts.get((unit[0], unit[1]), 0)
+                        if prev is None or not isinstance(metrics, dict):
+                            slot[unit[1]] = metrics
+                        else:
+                            # Batch-range units: running mean per
+                            # (epoch, partition) so the table keeps its
+                            # whole-partition shape (equal weight per
+                            # range; a short tail range is slightly
+                            # overweighted — metrics noise, not ledger
+                            # accounting).
+                            slot[unit[1]] = {
+                                k: (prev[k] * n + metrics[k]) / (n + 1)
+                                for k in prev if k in metrics
+                            }
+                        self._metric_counts[(unit[0], unit[1])] = n + 1
                     self._note_repaired(unit)
                 if finished_epoch is not None and self.on_epoch_complete is not None:
                     # Serialized: epoch fires run user callbacks and

@@ -144,6 +144,16 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             # through the real sharded-client path must SEE the outage
             # (failed probes on the killed shard) and see it end.
             ("canary_saw_outage", "equal", 0.0),
+            # Staleness row (--staleness): the hard admission bound must
+            # actually have refused deltas (True, exact — the sweep is
+            # seeded and single-threaded, so this is replay-stable, not
+            # a flaky count), bounding staleness must never converge
+            # WORSE than unbounded (absolute floor at 0 on
+            # loss(inf) - loss(max=2)), and the swept final trees must
+            # replay bit-identically.
+            ("staleness_rejected_nonzero", "equal", 0.0),
+            ("staleness_recovery_gain", "floor", 0.0),
+            ("staleness_digest", "equal", 0.0),
         ],
     ),
     "fleet": (

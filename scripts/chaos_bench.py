@@ -61,6 +61,17 @@ to the last acked state: zero acked-update loss), and the replay-stable
 drifts changed the data path). Both gated by ``bench_gate.py``
 (``shard_failover_mttr_s`` ceiling, ``acked_state_recovered`` equal).
 
+``--staleness`` appends a ``{"scenario": "staleness"}`` row: a fully
+deterministic convergence-vs-staleness sweep over the wire admission
+path — the same seeded fast/slow-worker schedule run against
+``max_staleness ∈ {∞, 8, 2}`` (a table of final loss + per-worker
+applied/damped/rejected counts from the PS's own ledger, replay-stable
+digest), plus the client-side AIMD sync-interval ratchet trajectory
+(4 → 2 → 1 under forced rejections, +0.25/accept recovery). Gated by
+``bench_gate.py``: ``staleness_rejected_nonzero`` (the hard bound must
+have refused deltas), the ``staleness_recovery_gain`` floor (bounded
+admission never converges worse than unbounded), and the digest.
+
 ``--fleet`` appends a ``{"scenario": "fleet"}`` row: the kill_ps chaos
 arm re-run with ops endpoints mounted on BOTH sides (the elastic PS via
 ``ps_ops_port``, the trainer process via ``mount_ops``) and a
@@ -662,6 +673,150 @@ def scenario_shard_kill(seed: int = 11, k: int = 2, updates: int = 6):
             group.stop()
 
 
+def scenario_staleness(seed: int = 11, steps: int = 60):
+    """``--staleness``: convergence vs the admission bound, measured
+    through the real socket wire path, fully deterministic (single
+    thread, seeded — same seed → same sweep table and digest).
+
+    The workload is a quadratic bowl (loss = ||w - w*||^2 / 2) pushed at
+    by two workers: a FAST one that re-pulls every step (lag 0, its
+    delta is the true gradient step), and a SLOW one that re-pulls only
+    every ``refresh`` steps but pushes every step — so between refreshes
+    it re-sends the gradient of an increasingly stale base, the classic
+    stale-delta overshoot. The sweep runs the identical seeded schedule
+    against ``max_staleness ∈ {∞, 8, 2}`` (soft bound at half the hard
+    bound): unbounded admission lets every stale push land (worst final
+    loss), damping decays them, and the hard bound rejects them outright
+    — the convergence-vs-staleness table the bounded-staleness trade
+    turns on. Rejected/damped counts come from the server's own
+    StalenessLedger, so the row also proves the wire admission path
+    end to end.
+
+    The row additionally commits the client half of the loop: a
+    ``_CommsPipeline`` with a units-per-push baseline of 4 driven
+    against the max_staleness=2 server — three forced rejections halve
+    its interval 4 → 2 → 1, then accepted pushes relax it +0.25 per
+    accept (``sync_interval_path``, replay-stable)."""
+    import hashlib
+
+    from elephas_tpu.parameter.client import (
+        StaleDeltaRejected, make_client,
+    )
+    from elephas_tpu.parameter.server import make_server
+
+    dim, lr, refresh = 8, 0.12, 12
+    rng = np.random.default_rng(seed)
+    target = rng.standard_normal(dim).astype(np.float32)
+    w0 = np.zeros(dim, np.float32)
+
+    def run_arm(bound):
+        soft = None if bound is None else max(1, bound // 2)
+        server = make_server(
+            "socket", {"params": {"w": w0.copy()}, "batch_stats": {}},
+            port=0, max_staleness=bound, staleness_soft=soft,
+        )
+        server.start()
+        try:
+            addr = f"127.0.0.1:{server.port}"
+            fast = make_client("socket", addr)
+            fast.worker_id = "fast"
+            slow = make_client("socket", addr)
+            slow.worker_id = "slow"
+            rejected = 0
+            stale_base = None
+            for step in range(steps):
+                cur = np.asarray(fast.get_parameters()["params"]["w"])
+                fast.update_parameters(
+                    {"params": {"w": lr * (cur - target)},
+                     "batch_stats": {}})
+                if step % refresh == 0:
+                    stale_base = np.asarray(
+                        slow.get_parameters()["params"]["w"])
+                try:
+                    slow.update_parameters(
+                        {"params": {"w": lr * (stale_base - target)},
+                         "batch_stats": {}})
+                except StaleDeltaRejected:
+                    rejected += 1
+            final = np.asarray(fast.get_parameters()["params"]["w"])
+            loss = 0.5 * float(np.sum((final - target) ** 2))
+            led = server.ledger.snapshot()["workers"]
+            fast.close()
+            slow.close()
+            return {
+                "max_staleness": "inf" if bound is None else bound,
+                "soft": soft,
+                "final_loss": round(loss, 5),
+                "slow_applied": led["slow"]["updates"],
+                "slow_damped": led["slow"]["damped"],
+                "slow_rejected": led["slow"]["rejected"],
+                "client_seen_rejected": rejected,
+            }, final
+        finally:
+            server.stop()
+
+    sweep, h = [], hashlib.sha256()
+    for bound in (None, 8, 2):
+        arm, final = run_arm(bound)
+        sweep.append(arm)
+        h.update(np.ascontiguousarray(final).tobytes())
+
+    # Client half of the loop: the AIMD sync-interval ratchet against a
+    # max_staleness=2 server. Every wire op is serialized (push then
+    # flush), so the interval trajectory is replay-stable.
+    from elephas_tpu.engine.async_engine import _CommsPipeline
+
+    server = make_server(
+        "socket", {"params": {"w": w0.copy()}, "batch_stats": {}},
+        port=0, max_staleness=2,
+    )
+    server.start()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        probe = make_client("socket", addr)
+        probe.worker_id = "ratchet"
+        feeder = make_client("socket", addr)
+        feeder.worker_id = "feeder"
+        zero = {"params": {"w": np.zeros(dim, np.float32)},
+                "batch_stats": {}}
+        pipe = _CommsPipeline(probe, 0, 1, sleep=lambda s: None,
+                              sync_interval=4.0)
+        path = [pipe.sync_interval]
+        for i in range(9):
+            pipe.pull()
+            if i < 3:  # stale window: advance 4 versions behind its back
+                for _ in range(4):
+                    feeder.get_parameters()
+                    feeder.update_parameters(zero)
+            pipe.push(zero)
+            pipe.flush()
+            path.append(round(pipe.sync_interval, 2))
+        pipe.close()
+        ratchet = {"sync_interval_path": path,
+                   "ratchet_rejections": pipe.rejections}
+        probe.close()
+        feeder.close()
+    finally:
+        server.stop()
+
+    loss_by = {row["max_staleness"]: row["final_loss"] for row in sweep}
+    return {
+        "scenario": "staleness",
+        "seed": seed,
+        "steps": steps,
+        "refresh": refresh,
+        "staleness_sweep": sweep,
+        # Gated bits: the hard bound MUST have refused deltas (the
+        # enforcement path ran), bounding staleness must recover
+        # convergence lost to unbounded stale applies (absolute floor
+        # 0: never worse), and the whole sweep must replay bit-stably.
+        "staleness_rejected_nonzero": sweep[-1]["slow_rejected"] > 0,
+        "staleness_recovery_gain": round(loss_by["inf"] - loss_by[2], 5),
+        "staleness_digest": h.hexdigest()[:16],
+        **ratchet,
+    }
+
+
 def export_role_dumps(tracer, outdir, prefix="chaos_trace"):
     """Split the in-process span ring into the per-role dumps a real
     deployment would collect from each process's ``/trace`` route:
@@ -711,6 +866,11 @@ def main(argv=None):
                     help="append the shard-kill row: K=2 ShardGroup with "
                          "warm standbys, one primary crashed, measured "
                          "promotion MTTR + zero-acked-loss digest check")
+    ap.add_argument("--staleness", action="store_true",
+                    help="append the bounded-staleness row: deterministic "
+                         "convergence-vs-max_staleness sweep (∞/8/2) over "
+                         "the wire admission path, plus the client "
+                         "sync-interval ratchet trajectory")
     ap.add_argument("--fleet", action="store_true",
                     help="append the federation row: kill_ps observed "
                          "through a FleetAggregator polling the PS and "
@@ -736,6 +896,8 @@ def main(argv=None):
         rows.append(scenario_health(x, y, args.epochs, seed=args.seed))
     if args.shards:
         rows.append(scenario_shard_kill(seed=args.seed))
+    if args.staleness:
+        rows.append(scenario_staleness(seed=args.seed))
     if args.fleet:
         rows.append(scenario_fleet(x, y, args.epochs, args.outage))
 

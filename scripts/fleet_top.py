@@ -81,6 +81,20 @@ def _goodput_cell(snap: dict, name: str, status: str) -> str:
     return f"{100.0 * ratio:.1f}%" if ratio is not None else "-"
 
 
+def _sync_cell(row: dict) -> str:
+    """SYNC column of the cluster worker ledger: the worker's
+    self-reported adaptive units-per-push interval, with its rejected
+    delta count in parentheses when the admission policy has refused
+    any. Unstamped legacy workers (no ``sync_interval`` in their row)
+    render '-' — they predate the ratchet wire stamp."""
+    interval = row.get("sync_interval")
+    cell = f"{interval:.2f}" if interval is not None else "-"
+    rejected = row.get("rejected")
+    if rejected:
+        cell += f"(rej={rejected})"
+    return cell
+
+
 def _replica_cells(rid: str, card: dict, proc_status: str) -> str:
     """One row of the replica board. Every signal column renders '-'
     when the router process itself is stale/dead (its roster stopped
@@ -168,7 +182,8 @@ def render(snap: dict) -> str:
                      f"total_updates={workers['total_updates']}")
         for wid, row in sorted(workers["workers"].items()):
             lines.append(f"  {wid:<12} updates={row.get('updates', '?')} "
-                         f"lag_max={row.get('lag_max', '?')}")
+                         f"lag_max={row.get('lag_max', '?')} "
+                         f"sync={_sync_cell(row)}")
     alerts = snap["alerts"]
     if alerts["active"] or alerts["fired_total"]:
         lines.append("")

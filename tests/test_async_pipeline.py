@@ -226,3 +226,105 @@ def test_close_is_idempotent_and_safe_after_fatal():
     pipe.push("d0")
     pipe.close()
     pipe.close()  # second close is a no-op, not an error
+
+
+# --------------------------------------------------------------------------
+# Adaptive sync-interval ratchet (bounded-staleness client half)
+# --------------------------------------------------------------------------
+
+
+def _reject(lag=5, bound=2):
+    from elephas_tpu.parameter.client import StaleDeltaRejected
+
+    return StaleDeltaRejected("127.0.0.1:0", version=lag, lag=lag,
+                              max_staleness=bound)
+
+
+def test_sync_interval_validates_and_stamps_client():
+    client = FakeClient()
+    with pytest.raises(ValueError, match="sync_interval"):
+        _CommsPipeline(client, 0, max_push_attempts=3, sync_interval=0.5)
+    with _closing(_CommsPipeline(client, 0, max_push_attempts=3,
+                                 sync_interval=2.0)) as pipe:
+        assert pipe.sync_interval == 2.0
+        # The stamp rides every push frame to the PS ledger / SYNC column.
+        assert client.sync_interval == 2.0
+        gauge = obs.default_registry().gauge(
+            "worker_sync_interval", labelnames=("worker",))
+        assert gauge.labels(worker="w0").value == 2.0
+
+
+def test_pushes_coalesce_per_interval_and_flush_sends_remainder():
+    """interval=3 → one wire push per 3 units, tree-summed; flush
+    flushes a partial accumulator so no delta is ever stranded."""
+    client = FakeClient()
+    with _closing(_CommsPipeline(client, 0, max_push_attempts=3,
+                                 sync_interval=3.0)) as pipe:
+        for delta in (1, 2, 3):  # scalar leaves sum like tree leaves
+            pipe.push(delta)
+        pipe.flush()
+        assert client.pushed == [6]
+        pipe.push(4)
+        pipe.push(5)
+        pipe.flush()  # remainder (2 of 3 units) goes out on flush
+        assert client.pushed == [6, 9]
+
+
+def test_rejection_halves_interval_drops_delta_and_forces_repull():
+    client = FakeClient()
+    client.push_failures[4] = [_reject()]
+    pipe = _CommsPipeline(client, 0, max_push_attempts=5,
+                          sleep=lambda s: None, sync_interval=4.0)
+    try:
+        pipe.prefetch()
+        assert client.pulls == 1 or pipe._pending is not None
+        for _ in range(4):
+            pipe.push(1)  # coalesced sum 4 → the scripted rejection
+        pipe.flush()  # the reject is definitive: flush must NOT raise
+        assert pipe.rejections == 1
+        assert client.pushed == []  # dropped, never retried
+        assert pipe.sync_interval == 2.0  # multiplicative halving
+        assert client.sync_interval == 2.0
+        # The pending prefetch predates the rejection: pull() discards
+        # it and goes back to the wire for the fresh version line.
+        pulls_before = client.pulls
+        assert pipe.pull() is not None
+        assert client.pulls == pulls_before + 1
+    finally:
+        pipe.close()
+
+
+def test_interval_floor_is_one_and_accepts_relax_back_to_baseline():
+    client = FakeClient()
+    client.push_failures[2] = [_reject(), _reject()]  # two rounds of 2
+    pipe = _CommsPipeline(client, 0, max_push_attempts=5,
+                          sleep=lambda s: None, sync_interval=2.0)
+    try:
+        for _ in range(2):
+            pipe.push(1)
+        pipe.flush()
+        assert pipe.sync_interval == 1.0  # 2.0 → 1.0
+        pipe.push(2)  # interval 1 → immediate wire push; scripted reject
+        pipe.flush()
+        assert pipe.sync_interval == 1.0  # floor: never below 1
+        assert pipe.rejections == 2
+        # Additive recovery: +0.25 per accepted push, capped at baseline.
+        for _ in range(6):
+            pipe.push(0)
+            pipe.flush()
+        assert pipe.sync_interval == 2.0  # 1.0 + 4*0.25, then capped
+        assert client.pushed  # the accepted zero-deltas reached the wire
+    finally:
+        pipe.close()
+
+
+def test_default_interval_is_preratchet_behavior():
+    """baseline 1.0 = one wire push per unit, byte-identical cadence to
+    the pre-ratchet pipeline (only counters move on rejection)."""
+    client = FakeClient()
+    with _closing(_CommsPipeline(client, 0, max_push_attempts=3)) as pipe:
+        for i in range(5):
+            pipe.push(i)
+        pipe.flush()
+        assert client.pushed == [0, 1, 2, 3, 4]  # no coalescing
+        assert pipe.sync_interval == 1.0

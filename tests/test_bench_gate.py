@@ -258,6 +258,38 @@ def test_shard_kill_rules_gate_mttr_and_acked_loss():
         "must be <= 10.0"
 
 
+def test_staleness_rules_gate_sweep_row():
+    """The --staleness chaos row: the hard bound must have refused
+    deltas (exact — the sweep is seeded and single-threaded), bounded
+    arms must never converge WORSE than unbounded (absolute floor at 0
+    on the recovery gain), and the swept final trees must replay
+    bit-identically (digest exact). Rows without the metrics (every
+    other scenario) are untouched."""
+    base = [{"scenario": "staleness", "staleness_rejected_nonzero": True,
+             "staleness_recovery_gain": 0.00125,
+             "staleness_digest": "54f103956484907b"},
+            {"scenario": "baseline", "completed_units": 8}]
+    drifted = bg.compare(base, [
+        {"scenario": "staleness", "staleness_rejected_nonzero": True,
+         "staleness_recovery_gain": 0.0,  # below baseline, above floor
+         "staleness_digest": "54f103956484907b"},
+        {"scenario": "baseline", "completed_units": 8}], "chaos")
+    assert all(c["ok"] for c in drifted)
+    broken = bg.compare(base, [
+        {"scenario": "staleness", "staleness_rejected_nonzero": False,
+         "staleness_recovery_gain": -0.01,
+         "staleness_digest": "deadbeefdeadbeef"},
+        {"scenario": "baseline", "completed_units": 8}], "chaos")
+    failed = sorted((c["key"], c["metric"]) for c in broken if not c["ok"])
+    assert failed == [("staleness", "staleness_digest"),
+                      ("staleness", "staleness_recovery_gain"),
+                      ("staleness", "staleness_rejected_nonzero")]
+    by = _checks_by_metric(bg.compare(base, base, "chaos"))
+    assert by[("staleness", "staleness_recovery_gain")]["threshold"] == \
+        "must be >= 0.0"
+    assert ("baseline", "staleness_digest") not in by  # absent → not gated
+
+
 def test_canary_overhead_rule_is_absolute_ceiling():
     """The --slo serve row's canary_overhead_pct rides the tracing
     guardrail's discipline: an absolute 2% ceiling, baseline ignored —

@@ -800,6 +800,36 @@ def test_alert_engine_rate_rule_burns_before_firing():
     assert fired[0]["rule"] == "worker_expiry_rate"
 
 
+def test_staleness_rejection_rate_rule_fires_on_labeled_counter():
+    """The bounded-staleness alert: its kind/name are in the registered
+    vocabularies, and the stock rule binds (by family prefix) to the
+    labeled ``ps_delta_rejected_total{reason=}`` child the PS admission
+    path actually bumps — firing only at a sustained rate."""
+    from elephas_tpu.obs import AlertEngine, default_rules
+
+    assert "delta_rejected" in obs.KINDS
+    assert "staleness_rejection_rate" in obs.RULE_NAMES
+    rule = next(r for r in default_rules()
+                if r.name == "staleness_rejection_rate")
+    assert rule.kind == "delta_rejected" and rule.mode == "rate"
+
+    reg = MetricsRegistry()
+    fr = FlightRecorder()
+    engine = AlertEngine(registry=reg, flight=fr, rules=[rule],
+                         clock=FakeClock(0.0))
+    child = reg.counter("ps_delta_rejected_total", help="probe",
+                        labelnames=("reason",)).labels(
+                            reason="max_staleness")
+    assert engine.evaluate(now=0.0) == []  # under-sampled
+    child.inc(30)
+    assert engine.evaluate(now=10.0) == []  # 3/s > 0.2: trip 1 of burn 2
+    child.inc(30)
+    fired = engine.evaluate(now=20.0)
+    assert [a["kind"] for a in fired] == ["delta_rejected"]
+    assert fired[0]["metric"] == \
+        'ps_delta_rejected_total{reason="max_staleness"}'
+
+
 def test_alert_engine_matches_labeled_children_per_worker():
     """One rule on a family prefix evaluates every labeled child — that
     is how worker_lagging singles out the straggler without a rule per

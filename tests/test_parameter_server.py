@@ -527,6 +527,187 @@ def test_trace_id_survives_kill_and_warm_restart(tmp_path):
         obs.disable_tracing()
 
 
+# --------------------------------------------------------------------------
+# Bounded-staleness admission (AdmissionPolicy + transports)
+# --------------------------------------------------------------------------
+
+
+def _zero_delta():
+    return {"dense": {"w": np.zeros((4, 4), np.float32),
+                      "b": np.zeros(4, np.float32)}}
+
+
+def _advance_version(server, n):
+    """Bump the buffer version by n zero deltas from a peer that never
+    pulled (unstamped → always admitted, buffer values unchanged)."""
+    fresh = server.client()
+    for _ in range(n):
+        fresh.update_parameters(_zero_delta())
+    if hasattr(fresh, "close"):
+        fresh.close()
+
+
+def test_admission_policy_decide_regimes():
+    from elephas_tpu.parameter.server import AdmissionPolicy
+
+    policy = AdmissionPolicy(max_staleness=8, soft=2)
+    assert policy.decide(None) == ("accept", 1.0)  # unstamped peers
+    assert policy.decide(2) == ("accept", 1.0)  # at the soft bound
+    verdict, weight = policy.decide(5)
+    assert verdict == "damp" and weight == pytest.approx(1.0 / 4.0)
+    assert policy.decide(8)[0] == "damp"  # at max: still applied
+    assert policy.decide(9) == ("reject", 0.0)
+    assert AdmissionPolicy().decide(10 ** 6) == ("accept", 1.0)
+
+
+def test_admission_env_knobs(monkeypatch):
+    from elephas_tpu.parameter.server import AdmissionPolicy
+
+    monkeypatch.setenv("ELEPHAS_MAX_STALENESS", "4")
+    monkeypatch.setenv("ELEPHAS_STALENESS_SOFT", "1")
+    policy = AdmissionPolicy()
+    assert policy.max_staleness == 4 and policy.soft == 1
+    assert AdmissionPolicy(max_staleness=9).max_staleness == 9  # arg wins
+    monkeypatch.setenv("ELEPHAS_MAX_STALENESS", "plenty")
+    with pytest.warns(RuntimeWarning, match="ELEPHAS_MAX_STALENESS"):
+        assert AdmissionPolicy().max_staleness is None  # warn, don't crash
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_stale_push_rejected_with_typed_error(server_cls):
+    """Past the hard bound the push must NOT apply: the client gets the
+    typed StaleDeltaRejected (with the measured lag and the bound), the
+    buffer is untouched, and the ledger counts a rejection WITHOUT an
+    update — rejected work must not read as contribution."""
+    from elephas_tpu import obs
+    from elephas_tpu.parameter.client import StaleDeltaRejected
+
+    rejected = obs.default_registry().counter(
+        "ps_delta_rejected_total", labelnames=("reason",))
+    before = rejected.labels(reason="max_staleness").value
+    server = server_cls(_params(), lock=True, port=0,
+                        max_staleness=2, staleness_soft=2)
+    server.start()
+    try:
+        stale = server.client()
+        stale.worker_id = "laggard"
+        stale.get_parameters()  # trains against version 0
+        _advance_version(server, 3)  # the fleet moves on
+        delta = {"dense": {"w": np.full((4, 4), 0.4, np.float32),
+                           "b": np.zeros(4, np.float32)}}
+        with pytest.raises(StaleDeltaRejected) as err:
+            stale.update_parameters(delta)
+        assert err.value.lag == 3
+        assert err.value.max_staleness == 2
+        assert err.value.version == 3  # the server's live version line
+        assert server.buffer.version == 3  # reject never applied
+        np.testing.assert_allclose(
+            server.buffer.get_numpy()["dense"]["w"], 1.0)
+        row = server.ledger.snapshot()["workers"]["laggard"]
+        assert row["rejected"] == 1
+        assert row["updates"] == 0  # accounting regression guard
+        assert rejected.labels(reason="max_staleness").value == before + 1
+        # Recovery protocol: re-pull, then the same delta is fresh.
+        stale.get_parameters()
+        stale.update_parameters(delta)
+        np.testing.assert_allclose(
+            server.buffer.get_numpy()["dense"]["w"], 0.6)
+        assert server.ledger.snapshot()["workers"]["laggard"]["updates"] == 1
+        if hasattr(stale, "close"):
+            stale.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_stale_push_damped_between_soft_and_max(server_cls):
+    """Inside (soft, max] the delta applies at the 1/(1+lag-soft) decay
+    weight — and counts as BOTH an update and a damped apply."""
+    from elephas_tpu import obs
+
+    damped = obs.default_registry().counter("ps_delta_damped_total")
+    before = damped.value
+    server = server_cls(_params(), lock=True, port=0,
+                        max_staleness=10, staleness_soft=1)
+    server.start()
+    try:
+        client = server.client()
+        client.worker_id = "behind"
+        client.get_parameters()  # version 0
+        _advance_version(server, 3)
+        delta = {"dense": {"w": np.full((4, 4), 0.6, np.float32),
+                           "b": np.zeros(4, np.float32)}}
+        client.update_parameters(delta)  # lag 3 → weight 1/3
+        np.testing.assert_allclose(
+            server.buffer.get_numpy()["dense"]["w"], 0.8, rtol=1e-6)
+        row = server.ledger.snapshot()["workers"]["behind"]
+        assert row["damped"] == 1 and row["updates"] == 1
+        assert row["lag_max"] == 3
+        assert damped.value == before + 1
+        if hasattr(client, "close"):
+            client.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_legacy_unstamped_push_ignores_bounds(server_cls):
+    """Pre-policy peers (pickle codec, no seen_version stamp) keep
+    their exact old behavior even under the tightest bounds: full-weight
+    apply, counted as unstamped coverage."""
+    from elephas_tpu.parameter.client import HttpClient, SocketClient
+
+    server = server_cls(_params(), lock=True, port=0,
+                        max_staleness=0, staleness_soft=0)
+    server.start()
+    try:
+        _advance_version(server, 2)  # any stamped lag would now reject
+        cls = HttpClient if server_cls is HttpServer else SocketClient
+        legacy = cls(f"127.0.0.1:{server.port}", codec="pickle")
+        delta = {"dense": {"w": np.full((4, 4), 0.5, np.float32),
+                           "b": np.zeros(4, np.float32)}}
+        legacy.update_parameters(delta)
+        assert server.buffer.version == 3
+        np.testing.assert_allclose(
+            server.buffer.get_numpy()["dense"]["w"], 0.5)
+        snap = server.ledger.snapshot()
+        assert snap["unstamped_updates"] >= 3
+        if hasattr(legacy, "close"):
+            legacy.close()
+    finally:
+        server.stop()
+
+
+def test_sharded_group_surfaces_rejection():
+    """Admission is per shard; a StaleDeltaRejected from any member
+    propagates through the sharded client's fanout, and a re-pull
+    resyncs every sub-cache so the retry is fresh."""
+    from elephas_tpu.parameter.client import StaleDeltaRejected
+    from elephas_tpu.parameter.group import ShardGroup
+
+    group = ShardGroup(_params(), 2, mode="socket", max_staleness=1)
+    group.start()
+    try:
+        client = group.client()
+        client.get_parameters()  # each shard caches its version 0
+        other = group.client()
+        for _ in range(2):  # every shard's version line moves to 2
+            other.update_parameters(_zero_delta())
+        delta = {"dense": {"w": np.full((4, 4), 0.25, np.float32),
+                           "b": np.zeros(4, np.float32)}}
+        with pytest.raises(StaleDeltaRejected) as err:
+            client.update_parameters(delta)
+        assert err.value.lag == 2 and err.value.max_staleness == 1
+        client.get_parameters()  # recovery: resync all K sub-caches
+        client.update_parameters(delta)
+        np.testing.assert_allclose(
+            group.get_parameters()["dense"]["w"], 0.75)
+        client.close()
+        other.close()
+    finally:
+        group.stop()
+
+
 def test_prob_losses_match_logit_losses():
     import jax.numpy as jnp
     from elephas_tpu.engine.losses import LOSSES

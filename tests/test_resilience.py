@@ -356,6 +356,83 @@ def test_ledger_rejects_empty_shapes():
         UnitLedger(1, [])
 
 
+def test_ledger_batch_range_keying_and_lease_order():
+    """batches_per_unit re-keys units to (epoch, partition, (lo, hi)):
+    half-open ranges cover every batch exactly once, the short tail
+    included, and leasing stays epoch-major."""
+    ledger = UnitLedger(2, [0, 1], n_batches=5, batches_per_unit=2)
+    assert ledger.ranges[0] == [(0, 2), (2, 4), (4, 5)]
+    assert ledger.units_per_epoch == 6
+    assert ledger.total_units == 12
+    order = [ledger.lease("w") for _ in range(6)]
+    assert order == [(0, 0, (0, 2)), (0, 0, (2, 4)), (0, 0, (4, 5)),
+                     (0, 1, (0, 2)), (0, 1, (2, 4)), (0, 1, (4, 5))]
+    assert ledger.lease("w")[0] == 1  # next epoch only after the first
+
+
+def test_ledger_batch_range_per_partition_sizes():
+    """n_batches may be a per-partition dict (uneven shards)."""
+    ledger = UnitLedger(1, ["a", "b"], n_batches={"a": 3, "b": 1},
+                        batches_per_unit=2)
+    assert ledger.ranges["a"] == [(0, 2), (2, 3)]
+    assert ledger.ranges["b"] == [(0, 1)]
+    assert ledger.units_per_epoch == 3
+
+
+def test_ledger_batches_per_unit_requires_n_batches():
+    with pytest.raises(ValueError):
+        UnitLedger(1, [0], batches_per_unit=2)
+
+
+def test_ledger_requeue_releases_only_unfinished_ranges():
+    """Requeue-on-death at batch-range granularity: the dead worker's
+    FINISHED ranges stay counted; only the in-flight ones re-lease."""
+    ledger = UnitLedger(1, [0], n_batches=6, batches_per_unit=2)
+    first = ledger.lease("dead")
+    second = ledger.lease("dead")
+    assert ledger.complete("dead", first) == (True, None)
+    assert ledger.requeue_worker("dead") == [second]  # not `first`
+    assert ledger.lease("survivor") == second  # hole re-leases first
+    assert ledger.completed_units == 1
+
+
+def test_ledger_zombie_range_completion_counts_once():
+    """Zombie fencing holds under range keying: the stalled worker's
+    copy completing cancels the requeued duplicate."""
+    ledger = UnitLedger(1, [0], n_batches=2, batches_per_unit=1)
+    unit = ledger.lease("zombie")
+    other = ledger.lease("zombie")
+    ledger.requeue_worker("zombie")
+    counted, finished = ledger.complete("zombie", unit)
+    assert counted and finished is None
+    # Both duplicates went back; draining them closes the epoch exactly.
+    assert ledger.lease("survivor") == other
+    counted, finished = ledger.complete("survivor", other)
+    assert counted and finished == 0
+    assert ledger.lease("survivor") is None
+    assert ledger.all_done() and ledger.completed_units == 2
+
+
+def test_ledger_epoch_done_fires_once_under_shuffled_completion():
+    """Regression: epoch-finished accounting must compare against the
+    per-epoch UNIT count, not the partition count — with ranges there
+    are more units than partitions, and completions arrive out of
+    order across epochs."""
+    import random
+
+    ledger = UnitLedger(2, [0, 1], n_batches=4, batches_per_unit=2)
+    units = [ledger.lease("w") for _ in range(ledger.total_units)]
+    random.Random(7).shuffle(units)
+    fired = []
+    for unit in units:
+        counted, finished = ledger.complete("w", unit)
+        assert counted
+        if finished is not None:
+            fired.append(finished)
+    assert sorted(fired) == [0, 1]  # each epoch exactly once
+    assert ledger.all_done()
+
+
 # --------------------------------------------------------------------------
 # ElasticWorkerPool (fake clients — no parameter server, no wire)
 # --------------------------------------------------------------------------
@@ -402,6 +479,26 @@ def test_pool_drains_ledger_and_reports_stats():
     assert fired == [0, 1, 2]  # every epoch fires exactly once, in order
     assert len(done) == 6
     assert pool.epoch_metrics()[2][1] == {"n": 1}
+
+
+def test_pool_range_units_mean_into_one_metric_slot():
+    """Range units report per-range metrics; the pool running-means
+    them into the single (epoch, partition) slot so epoch_metrics()
+    keeps its pre-range shape for downstream consumers."""
+    ledger = UnitLedger(1, [0], n_batches=4, batches_per_unit=2)
+    losses = iter([4.0, 2.0])
+
+    pool = ElasticWorkerPool(
+        ledger,
+        run_unit=lambda wid, client, unit: {"loss": next(losses)},
+        client_factory=lambda wid: _FakeClient({}),
+        worker_ids=["w0"],
+        monitor_poll=0.005, idle_wait=0.001,
+    )
+    pool.start()
+    stats = pool.wait()
+    assert stats["completed_units"] == 2
+    assert pool.epoch_metrics() == {0: {0: {"loss": 3.0}}}
 
 
 def test_pool_requeues_injected_death_to_survivor():
