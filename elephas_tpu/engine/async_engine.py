@@ -742,14 +742,18 @@ class AsyncTrainer:
 
     # -------------------------------------------------------------------------
 
-    def mount_ops(self, port: int = 0, host: Optional[str] = None):
+    def mount_ops(self, port: int = 0, host: Optional[str] = None,
+                  store_dir: Optional[str] = None):
         """Mount a live introspection endpoint for THIS worker process
         (role ``worker``): ``/metrics`` serves the process registry the
         training loop already feeds, ``/history`` its sampled rings,
         ``/profile`` device capture + memory watermarks. A fleet
         aggregator polls this next to the PS's own endpoint so trainer
         and server sides of an outage are visible together. Loopback by
-        default; idempotent; ``unmount_ops()`` tears it down."""
+        default; idempotent; ``unmount_ops()`` tears it down.
+        ``store_dir`` additionally journals this worker's flight notes,
+        alert transitions, and sampler ticks into a durable telemetry
+        store (``obs.store``) for post-mortem reconstruction."""
         if self.ops is not None:
             return self.ops
         from elephas_tpu import obs
@@ -763,6 +767,14 @@ class AsyncTrainer:
         self._ops_history = obs.HistorySampler(
             extra_fn=record_device_memory).start()
         self._ops_alerts = obs.AlertEngine()
+        self.store = None
+        if store_dir is not None:
+            self.store = obs.TelemetryStore(
+                store_dir, role="worker",
+                flight=obs.default_flight_recorder())
+            obs.default_flight_recorder().attach_store(self.store)
+            self._ops_alerts.attach_store(self.store)
+            self._ops_history.attach_store(self.store)
         self.ops = OpsServer(
             port=port, host=host, role="worker", worker_id=worker_id,
             alerts_fn=self._ops_alerts.scrape,
@@ -774,6 +786,8 @@ class AsyncTrainer:
                 "frequency": self.frequency,
                 "elastic": self.elastic,
             },
+            incidents_fn=(self.store.doc if self.store is not None
+                          else None),
         ).start()
         return self.ops
 
@@ -784,6 +798,15 @@ class AsyncTrainer:
         if self._ops_history is not None:
             self._ops_history.stop()
             self._ops_history = None
+        store = getattr(self, "store", None)
+        if store is not None:
+            from elephas_tpu import obs
+            obs.default_flight_recorder().detach_store(store)
+            alerts = getattr(self, "_ops_alerts", None)
+            if alerts is not None:
+                alerts.detach_store(store)
+            store.close()
+            self.store = None
 
     def _build_ps_group(self, store0, auth_key):
         """Start the K-shard PS group (plus its standby tier and

@@ -151,15 +151,19 @@ class SyncTrainer:
 
     # -- observability ---------------------------------------------------------
 
-    def mount_ops(self, port: int = 0, host: Optional[str] = None):
+    def mount_ops(self, port: int = 0, host: Optional[str] = None,
+                  store_dir: Optional[str] = None):
         """Mount a live introspection endpoint for this (single-process,
         SPMD) trainer — role ``worker``: ``/metrics`` serves the process
         registry the compiled-step counters feed, ``/history`` its
         sampled rings, ``/profile`` device capture + per-device memory
         watermarks (the hook the ROADMAP's real-chip runs need).
-        Loopback by default; idempotent."""
+        Loopback by default; idempotent. ``store_dir`` additionally
+        journals flight notes and sampler ticks into a durable telemetry
+        store (``obs.store``) for post-mortem reconstruction."""
         if self.ops is not None:
             return self.ops
+        from elephas_tpu import obs
         from elephas_tpu.obs.devprof import record_device_memory
         from elephas_tpu.obs.history import HistorySampler
         from elephas_tpu.obs.opsd import OpsServer
@@ -170,6 +174,13 @@ class SyncTrainer:
             worker_id = "w0"
         self._ops_history = HistorySampler(
             extra_fn=record_device_memory).start()
+        self.store = None
+        if store_dir is not None:
+            self.store = obs.TelemetryStore(
+                store_dir, role="worker",
+                flight=obs.default_flight_recorder())
+            obs.default_flight_recorder().attach_store(self.store)
+            self._ops_history.attach_store(self.store)
         self.ops = OpsServer(
             port=port, host=host, role="worker", worker_id=worker_id,
             history=self._ops_history,
@@ -179,6 +190,8 @@ class SyncTrainer:
                 "frequency": self.frequency,
                 "n_shards": self.n_shards,
             },
+            incidents_fn=(self.store.doc if self.store is not None
+                          else None),
         ).start()
         return self.ops
 
@@ -189,6 +202,12 @@ class SyncTrainer:
         if self._ops_history is not None:
             self._ops_history.stop()
             self._ops_history = None
+        store = getattr(self, "store", None)
+        if store is not None:
+            from elephas_tpu import obs
+            obs.default_flight_recorder().detach_store(store)
+            store.close()
+            self.store = None
 
     # -- compiled bodies -------------------------------------------------------
 

@@ -97,6 +97,17 @@ from elephas_tpu.obs.canary import (  # noqa: F401
     CanaryDriver,
     PSCanary,
 )
+from elephas_tpu.obs.store import (  # noqa: F401
+    RECORD_KINDS,
+    TelemetryStore,
+    iter_records,
+    read_store,
+    store_dirs,
+)
+from elephas_tpu.obs.incident import (  # noqa: F401
+    IncidentBuilder,
+    render_markdown,
+)
 
 _tracer: Tracer = NULL_TRACER
 _registry = MetricsRegistry()

@@ -186,6 +186,7 @@ class AlertEngine:
         # two-point-delta bookkeeping scheme.
         self._points: Dict[Tuple[str, str], HistoryRing] = {}
         self.fired: List[Dict[str, Any]] = []
+        self._stores: tuple = ()  # durable tees (obs/store.py), COW
 
     # -- surface resolution (late, so process globals rebind) ---------------
 
@@ -202,6 +203,20 @@ class AlertEngine:
         from elephas_tpu import obs
 
         return obs.default_flight_recorder()
+
+    # -- durable tee --------------------------------------------------------
+
+    def attach_store(self, store) -> None:
+        """Journal every subsequent fire/clear transition into ``store``
+        (a ``TelemetryStore``) at transition time — alert history must
+        survive SIGKILL, not just the next scrape. Idempotent."""
+        with self._lock:
+            if store not in self._stores:
+                self._stores = self._stores + (store,)
+
+    def detach_store(self, store) -> None:
+        with self._lock:
+            self._stores = tuple(s for s in self._stores if s is not store)
 
     # -- evaluation ---------------------------------------------------------
 
@@ -235,7 +250,9 @@ class AlertEngine:
             now = self.clock()
         snap = self._get_registry().snapshot()
         new_fired: List[Dict[str, Any]] = []
+        new_cleared: List[Dict[str, Any]] = []
         with self._lock:
+            stores = self._stores
             for rule in self.rules:
                 for key in self._match(rule.metric, snap):
                     measured = self._measure(rule, key, snap[key], now)
@@ -246,6 +263,16 @@ class AlertEngine:
                                else measured < rule.threshold)
                     state = (rule.name, key)
                     if not tripped:
+                        if self._breached.get(state):
+                            # latched breach evaluating clean: the
+                            # clear transition is history worth keeping
+                            # as much as the fire was.
+                            new_cleared.append({
+                                "rule": rule.name, "kind": rule.kind,
+                                "severity": rule.severity, "metric": key,
+                                "value": measured,
+                                "threshold": rule.threshold, "t": now,
+                            })
                         self._trips[state] = 0
                         self._breached[state] = False
                         continue
@@ -271,6 +298,15 @@ class AlertEngine:
                 "alerts_fired_total",
                 help="SLO alert breaches fired, by rule",
                 labelnames=("rule",)).labels(rule=alert["rule"]).inc()
+        # Durable tee: both transition edges journal at transition time.
+        for store in stores:
+            try:
+                for alert in new_fired:
+                    store.record_alert("fire", alert)
+                for alert in new_cleared:
+                    store.record_alert("clear", alert)
+            except Exception:
+                pass
         return new_fired
 
     # -- read-out -----------------------------------------------------------

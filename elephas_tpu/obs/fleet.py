@@ -470,7 +470,7 @@ class FleetAggregator:
         # can mix newer engines with older procs (or fakes) that don't
         # serve them, and their absence must not fail the whole poll —
         # each is fetched in its own tolerant attempt.
-        for route in ("/load", "/slo", "/replicas"):
+        for route in ("/load", "/slo", "/replicas", "/incidents"):
             try:
                 scrape[route[1:]] = json.loads(
                     self.fetch(f"{entry.url}{route}", self.timeout))
@@ -530,6 +530,12 @@ class FleetAggregator:
         per_replicas = {e.name: e.scrape["replicas"]
                         for e in entries
                         if e.scrape.get("replicas", {}).get("replicas")}
+        # Durable-store meta (/incidents): only procs with a mounted
+        # telemetry store contribute — the fleet board's DISK column
+        # reads bytes + last-persisted age from here.
+        per_incidents = {e.name: e.scrape["incidents"]
+                         for e in entries
+                         if e.scrape.get("incidents", {}).get("meta")}
         status_counts: Dict[str, int] = {}
         for e in entries:
             status_counts[e.status] = status_counts.get(e.status, 0) + 1
@@ -545,4 +551,5 @@ class FleetAggregator:
             "load": per_load,
             "slo": per_slo,
             "replicas": per_replicas,
+            "incidents": per_incidents,
         }

@@ -74,6 +74,9 @@ KINDS = (
     # bounded-staleness admission (parameter/server.py): a pushed delta
     # exceeded the hard max_staleness bound and was refused outright
     "delta_rejected",
+    # durable telemetry store (obs/store.py): a torn segment tail was
+    # truncated on warm reopen (predecessor boot died mid-append)
+    "store_corrupt_tail",
 )
 
 
@@ -127,6 +130,7 @@ class FlightRecorder:
         self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._dropped_counter = None  # lazily bound on first overwrite
+        self._stores: tuple = ()  # durable tees (obs/store.py), COW
 
     def note(self, kind: str, severity: str = "warn",
              **detail) -> Optional[FlightEvent]:
@@ -151,6 +155,15 @@ class FlightRecorder:
             if overwrote:
                 self.dropped += 1
             self._events.append(event)
+        # Durable tee: anomalies reach disk at note() time, so a SIGKILL
+        # between now and any clean dump loses nothing (obs/store.py).
+        # The tuple is copy-on-write — no lock on the hot path; a store
+        # must never take a host down with it.
+        for store in self._stores:
+            try:
+                store.record_flight(event)
+            except Exception:
+                pass
         if overwrote:
             # Silent anomaly loss must itself be observable: mirror the
             # tracer's truncation counter in the process registry so
@@ -172,6 +185,22 @@ class FlightRecorder:
             if counter:
                 counter.inc()
         return event
+
+    # -- durable tee -------------------------------------------------------
+
+    def attach_store(self, store) -> None:
+        """Tee every subsequent ``note()`` into ``store`` (a
+        ``TelemetryStore``). Idempotent; multiple co-hosted processes
+        may each attach their own store to the shared recorder —
+        ``obs/incident.py`` dedupes the copies after the fact."""
+        with self._lock:
+            if store not in self._stores:
+                self._stores = self._stores + (store,)
+
+    def detach_store(self, store) -> None:
+        """Stop teeing into ``store`` (unmount/kill path). Idempotent."""
+        with self._lock:
+            self._stores = tuple(s for s in self._stores if s is not store)
 
     # -- read-out ----------------------------------------------------------
 

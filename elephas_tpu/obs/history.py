@@ -186,6 +186,7 @@ class HistorySampler:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._stores: tuple = ()  # durable tees (obs/store.py), COW
 
     def _get_registry(self):
         if self._registry is not None:
@@ -211,6 +212,7 @@ class HistorySampler:
                 pass  # a broken watermark probe must not stop sampling
         snap = self._get_registry().snapshot()
         recorded = 0
+        sampled: Dict[str, float] = {}
         for key, value in snap.items():
             if not self._selected(key):
                 continue
@@ -224,10 +226,31 @@ class HistorySampler:
                     ring = self.rings.setdefault(
                         key, HistoryRing(capacity=self.capacity))
             ring.push(now, value)
+            sampled[key] = float(value)
             recorded += 1
         self.ticks += 1
         self._last_tick = now
+        # Durable tee: the tick's sampled name→value map journals as one
+        # ``metric`` record, so a post-mortem has the metric excerpts
+        # the in-memory rings would have lost with the process.
+        for store in self._stores:
+            try:
+                store.record_metrics(sampled, self.ticks)
+            except Exception:
+                pass
         return recorded
+
+    # -- durable tee --------------------------------------------------------
+
+    def attach_store(self, store) -> None:
+        """Journal every subsequent tick into ``store``. Idempotent."""
+        with self._lock:
+            if store not in self._stores:
+                self._stores = self._stores + (store,)
+
+    def detach_store(self, store) -> None:
+        with self._lock:
+            self._stores = tuple(s for s in self._stores if s is not store)
 
     def maybe_tick(self, now: Optional[float] = None) -> bool:
         """``tick`` iff at least ``period_s`` elapsed since the last."""

@@ -33,7 +33,10 @@ debugger:
 - ``GET /replicas`` — the serving router's roster: per-replica
   lifecycle state and dispatch signals, router affinity/requeue
   counters, and the last autoscale decision
-  (``serving.fleet.Router.replicas_doc``).
+  (``serving.fleet.Router.replicas_doc``);
+- ``GET /incidents`` — the durable telemetry store's live view: disk
+  stats (bytes, segments, last-record age) plus the most recent
+  journaled records (``obs.store.TelemetryStore.doc``).
 
 Routes are registered in an explicit table (``_add_route``), and the
 full vocabulary lives in the module-level ``ROUTES`` constant —
@@ -88,6 +91,7 @@ ROUTES = (
     "/slo",
     "/canary",
     "/replicas",
+    "/incidents",
 )
 
 
@@ -140,6 +144,10 @@ class OpsServer:
     replicas_fn: the ``/replicas`` payload (a serving fleet
         ``Router.replicas_doc`` — replica roster + dispatch signals +
         last autoscale decision); empty roster when unset.
+    incidents_fn: the ``/incidents`` payload (a ``TelemetryStore.doc``
+        — durable-store disk stats + most recent journaled records,
+        the live end of the post-mortem plane); empty store when
+        unset.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
@@ -156,7 +164,8 @@ class OpsServer:
                  load_fn: Optional[Callable[[], Dict]] = None,
                  slo_fn: Optional[Callable[[], Dict]] = None,
                  canary_fn: Optional[Callable[[], Dict]] = None,
-                 replicas_fn: Optional[Callable[[], Dict]] = None):
+                 replicas_fn: Optional[Callable[[], Dict]] = None,
+                 incidents_fn: Optional[Callable[[], Dict]] = None):
         self._requested_port = port
         self.host = host if host is not None else _default_bind_host()
         self._registry = registry
@@ -177,6 +186,7 @@ class OpsServer:
         self._slo_fn = slo_fn
         self._canary_fn = canary_fn
         self._replicas_fn = replicas_fn
+        self._incidents_fn = incidents_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_wall = None
@@ -201,6 +211,7 @@ class OpsServer:
         self._add_route("/slo", self._h_slo)
         self._add_route("/canary", self._h_canary)
         self._add_route("/replicas", self._h_replicas)
+        self._add_route("/incidents", self._h_incidents)
 
     def _add_route(self, path: str, handler: Callable) -> None:
         self._routes[path] = handler
@@ -354,6 +365,11 @@ class OpsServer:
         if self._replicas_fn is not None:
             return 200, self._replicas_fn()
         return 200, {"replicas": {}, "router": None, "autoscale": None}
+
+    def _h_incidents(self, query):
+        if self._incidents_fn is not None:
+            return 200, self._incidents_fn()
+        return 200, {"meta": None, "recent": []}
 
     def start(self) -> "OpsServer":
         if self._httpd is not None:

@@ -283,6 +283,7 @@ class Tracer:
         self._annotation_cls = None  # resolved lazily (jax import)
         self.dropped = 0  # ring overwrites of unexported spans
         self._dropped_counter = None  # lazily bound registry counter
+        self._stores: tuple = ()  # durable tees (obs/store.py), COW
 
     def _append(self, event: SpanEvent) -> None:
         events = self._events
@@ -304,6 +305,32 @@ class Tracer:
             if counter:
                 counter.inc()
         events.append(event)
+        # Durable tee: every COMPLETED span summary (this is the single
+        # sink — __exit__, record(), instant() all land here) journals
+        # so a post-mortem keeps the recent span history the ring loses
+        # with the process. Summaries only: name/duration/ids, no args
+        # beyond what the incident timeline needs.
+        for store in self._stores:
+            try:
+                store.record_span(
+                    {"name": event.name, "begin_s": event.begin_s,
+                     "dur_s": event.end_s - event.begin_s,
+                     "track": event.track, "trace_id": event.trace_id},
+                    mono_s=event.end_s,
+                )
+            except Exception:
+                pass
+
+    # -- durable tee -------------------------------------------------------
+
+    def attach_store(self, store) -> None:
+        """Journal every subsequent completed span summary into
+        ``store`` (a ``TelemetryStore``). Idempotent."""
+        if store not in self._stores:
+            self._stores = self._stores + (store,)
+
+    def detach_store(self, store) -> None:
+        self._stores = tuple(s for s in self._stores if s is not store)
 
     # -- recording ---------------------------------------------------------
 

@@ -680,7 +680,8 @@ class ShardGroup(BaseParameterServer):
         self._lock = threading.Lock()
         self._started = False
 
-        def build(shard: int, role: str, ops: Optional[int]):
+        def build(shard: int, role: str, ops: Optional[int],
+                  store_dir: Optional[str] = "auto"):
             wal_dir = (os.path.join(wal_root, f"shard{shard}")
                        if wal_root else None)
             return make_server(
@@ -696,6 +697,7 @@ class ShardGroup(BaseParameterServer):
                 # that shard's own version line.
                 max_staleness=max_staleness,
                 staleness_soft=staleness_soft,
+                store_dir=store_dir,
             )
 
         def ops_at(offset: int) -> Optional[int]:
@@ -706,8 +708,16 @@ class ShardGroup(BaseParameterServer):
         self._active: List[BaseParameterServer] = [
             build(i, f"ps/shard{i}", ops_at(i)) for i in range(k)
         ]
+        # A spare shares its shard's wal_dir (WAL streaming) but must
+        # NOT share its telemetry directory: the store's open-time tail
+        # healing assumes one live writer per directory, and a spare and
+        # its primary are alive at once. Each spare journals under its
+        # own ``standby<i>`` slot instead of the "auto" placement.
         self._standbys: List[Optional[BaseParameterServer]] = [
-            build(i, "ps/standby", ops_at(k + i)) if standby else None
+            build(i, "ps/standby", ops_at(k + i),
+                  store_dir=os.path.join(wal_root, f"standby{i}",
+                                         "telemetry"))
+            if standby else None
             for i in range(k)
         ]
         for member in self._active + self._standbys:
@@ -867,6 +877,13 @@ class ShardGroup(BaseParameterServer):
         # endpoint mounts, so the fleet board shows the new topology.
         spare._unmount_ops()
         spare.role = f"ps/shard{shard}"
+        if getattr(spare, "store", None) is not None:
+            # The journal survives the remount: re-stamp its role and
+            # mark the hat-change, so a post-mortem reads the standby's
+            # records and the promoted primary's as one process story.
+            spare.store.set_role(spare.role)
+            spare.store.record_lifecycle(
+                "promoted", shard=shard, old_boot=old_boot)
         try:
             dead.stop()  # a crashed server no-ops; a live one is demoted
         except Exception:

@@ -443,12 +443,53 @@ class _ObservableServerMixin:
 
     Expects the host class to set ``tracer`` (override or None),
     ``ops_port``, ``ops``, ``flight_dump``, ``_wal_dir``, ``buffer``,
-    ``detector``, ``boot``, ``host``, ``port``, ``ledger``, ``alerts``.
+    ``detector``, ``boot``, ``host``, ``port``, ``ledger``, ``alerts``,
+    ``store``.
     """
 
     def _tracer(self):
         # Resolved per use: an enable_tracing() after start() is seen.
         return self.tracer if self.tracer is not None else obs.default_tracer()
+
+    def _attach_telemetry_store(self, store_dir) -> None:
+        """Mount the durable telemetry journal (``obs.store``) next to
+        the WAL: the process-global flight recorder and this server's
+        alert engine tee into it from construction on, so anomalies
+        that precede ``start()`` (WAL restore, tail healing) are
+        journaled too. ``"auto"`` resolves to ``<wal_dir>/telemetry``
+        (disabled when there is no WAL); an explicit path mounts there
+        regardless — standbys need that, they share the shard's
+        ``wal_dir`` but must not share a store directory (open-time
+        tail healing assumes one live writer per directory)."""
+        self.store = None
+        if store_dir == "auto":
+            store_dir = (os.path.join(self._wal_dir, "telemetry")
+                         if self._wal_dir else None)
+        if store_dir is None:
+            return
+        self.store = obs.TelemetryStore(
+            store_dir, role=self.role, boot=self.boot,
+            flight=obs.default_flight_recorder())
+        obs.default_flight_recorder().attach_store(self.store)
+        self.alerts.attach_store(self.store)
+        tracer = self._tracer()
+        if getattr(tracer, "enabled", False):
+            tracer.attach_store(self.store)
+
+    def _close_store(self, reason: str) -> None:
+        store = getattr(self, "store", None)
+        if store is None:
+            return
+        obs.default_flight_recorder().detach_store(store)
+        self.alerts.detach_store(store)
+        sampler = getattr(self, "_ops_history", None)
+        if sampler is not None:
+            sampler.detach_store(store)
+        tracer = self._tracer()
+        if hasattr(tracer, "detach_store"):
+            tracer.detach_store(store)
+        store.close(reason=reason)
+        self.store = None
 
     def _mount_ops(self, transport: str) -> None:
         if self.ops_port is None:
@@ -466,6 +507,8 @@ class _ObservableServerMixin:
         # the flight dump, the WAL, and any device captures.
         self._ops_history = HistorySampler(
             extra_fn=record_device_memory).start()
+        if getattr(self, "store", None) is not None:
+            self._ops_history.attach_store(self.store)
         self._ops_profiler = DeviceProfiler(out_dir=self._wal_dir)
         self.ops = OpsServer(
             port=self.ops_port,
@@ -482,6 +525,9 @@ class _ObservableServerMixin:
             # Group members get this stamped by ShardGroup (the group
             # topology doc); standalone servers serve the empty shell.
             shards_fn=getattr(self, "shards_fn", None),
+            incidents_fn=(self.store.doc
+                          if getattr(self, "store", None) is not None
+                          else None),
         ).start()
 
     def _unmount_ops(self) -> None:
@@ -494,13 +540,20 @@ class _ObservableServerMixin:
             self._ops_history = None
 
     def _record_kill(self) -> None:
-        """Flight-record the crash and dump the ring to disk — BEFORE
-        connections are severed, so the artifact exists even though the
-        'process' skips every clean-shutdown sync."""
+        """Flight-record the crash and dump the ring to disk — AFTER
+        connections are severed (the crash is atomic to clients; the
+        version in the note is the one the kill froze) but before
+        ``kill()`` returns, so the artifact always exists even though
+        the 'process' skips every clean-shutdown sync. The telemetry
+        store closes AFTER the note, so ``ps_kill`` is the last
+        journaled event — the record a post-mortem rebuild names as
+        the trigger (a real crash handler closes the journal from the
+        same hook that dumps the flight ring)."""
         obs.default_flight_recorder().note(
             "ps_kill", "error", boot=self.boot, version=self.buffer.version,
         )
         self.flight_dump = _dump_flight_on_kill(self.boot, self._wal_dir)
+        self._close_store("kill")
 
 
 class HttpServer(_ObservableServerMixin, BaseParameterServer):
@@ -530,6 +583,7 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         shard_info: Optional[dict] = None,
         max_staleness: Optional[int] = None,
         staleness_soft: Optional[int] = None,
+        store_dir: Optional[str] = "auto",
     ):
         """``auth_key``: shared HMAC-SHA256 secret. When set, every
         request must carry ``X-Elephas-Auth`` = hexmac(method + path +
@@ -565,7 +619,13 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         the route 404s and sharded clients refuse this server.
         ``max_staleness``/``staleness_soft``: the bounded-staleness
         admission knobs (see ``AdmissionPolicy``; env fallbacks
-        ``ELEPHAS_MAX_STALENESS``/``ELEPHAS_STALENESS_SOFT``)."""
+        ``ELEPHAS_MAX_STALENESS``/``ELEPHAS_STALENESS_SOFT``).
+        ``store_dir``: durable telemetry journal directory
+        (``obs.store``). The default ``"auto"`` mounts at
+        ``<wal_dir>/telemetry`` when a WAL is configured (none
+        otherwise); ``None`` disables; an explicit path mounts there —
+        shard-group standbys pass one, they share the shard's
+        ``wal_dir`` but need their own journal directory."""
         self.buffer = ParameterBuffer(params, lock=lock, device=device,
                                       granularity=granularity)
         self.host = host if host is not None else _default_bind_host()
@@ -591,6 +651,7 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         self.shard_info = shard_info
         self.flight_dump: Optional[str] = None
         self._wal_dir = wal_dir
+        self._attach_telemetry_store(store_dir)
         self._httpd = None
         self._thread = None
 
@@ -832,6 +893,7 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
 
     def stop(self) -> None:
         self._unmount_ops()
+        self._close_store("close")
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -848,12 +910,17 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         signal/atexit hook, and the post-mortem needs the anomaly ring
         precisely when the shutdown was unclean."""
         if self._httpd is not None:
-            self._record_kill()
-            self._unmount_ops()
-            self._httpd.shutdown()
+            # Go dark FIRST: a crash is atomic from the clients' side,
+            # and recording before the sever would let late pushes keep
+            # landing while the flight dump + journal close run. Sever
+            # before shutdown() — shutdown blocks on the serve loop's
+            # poll interval, and handler threads keep acking during it.
             self._httpd.sever_all()
+            self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+            self._record_kill()
+            self._unmount_ops()
 
     def get_parameters(self):
         return self.buffer.get()
@@ -1082,6 +1149,7 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         shard_info: Optional[dict] = None,
         max_staleness: Optional[int] = None,
         staleness_soft: Optional[int] = None,
+        store_dir: Optional[str] = "auto",
     ):
         """``auth_key``: shared HMAC-SHA256 secret — every frame in both
         directions carries a tag (nonce+timestamp under the MAC) verified
@@ -1089,7 +1157,8 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         (see ``utils.sockets.send/receive``/``ReplayGuard``).
         ``wal_dir``/``wal_every``/``heartbeat_timeout``/``tracer``/
         ``ops_port``/``role``/``shard_info``/``max_staleness``/
-        ``staleness_soft``: see ``HttpServer`` — identical durability,
+        ``staleness_soft``/``store_dir``: see ``HttpServer`` —
+        identical durability,
         liveness, observability, shard-group handshake, and staleness
         admission semantics (here the rejection reply is the raw
         ``EPRJ`` frame in place of the ``b"ok"`` ack)."""
@@ -1116,6 +1185,7 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         self.shard_info = shard_info
         self.flight_dump: Optional[str] = None
         self._wal_dir = wal_dir
+        self._attach_telemetry_store(store_dir)
         self._server = None
         self._thread = None
 
@@ -1141,6 +1211,7 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
 
     def stop(self) -> None:
         self._unmount_ops()
+        self._close_store("close")
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -1156,12 +1227,15 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         flight recorder IS dumped first (``flight_dump``) — the
         post-mortem artifact a real crash handler would emit."""
         if self._server is not None:
-            self._record_kill()
-            self._unmount_ops()
-            self._server.shutdown()
+            # Sever first (crash is atomic to clients; shutdown() alone
+            # blocks on the poll interval while handlers keep acking),
+            # record after — the ps_kill note carries the frozen version.
             self._server.sever_all()
+            self._server.shutdown()
             self._server.server_close()
             self._server = None
+            self._record_kill()
+            self._unmount_ops()
 
     def get_parameters(self):
         return self.buffer.get()
@@ -1192,6 +1266,7 @@ def make_server(
     shard_info: Optional[dict] = None,
     max_staleness: Optional[int] = None,
     staleness_soft: Optional[int] = None,
+    store_dir: Optional[str] = "auto",
 ) -> BaseParameterServer:
     """Factory keyed on the reference's ``parameter_server_mode``.
     ``granularity`` ('tree'|'leaf') sets the hogwild apply isolation —
@@ -1208,7 +1283,9 @@ def make_server(
     standalone server keeps the defaults).
     ``max_staleness``/``staleness_soft``: bounded-staleness admission
     (wire transports only — a local client applies in-process under the
-    buffer lock, so its deltas are never stale)."""
+    buffer lock, so its deltas are never stale). ``store_dir``: durable
+    telemetry journal (wire transports; ``"auto"`` mounts next to the
+    WAL — see ``HttpServer``)."""
     if mode == "local":
         if wal_dir is not None:
             raise ValueError(
@@ -1227,6 +1304,12 @@ def make_server(
                 "(http|socket): local pushes apply under the buffer lock "
                 "and are never stale"
             )
+        if store_dir not in (None, "auto"):
+            raise ValueError(
+                "store_dir requires a wire transport (http|socket): the "
+                "local server's telemetry dies with the training process "
+                "a post-mortem would reconstruct"
+            )
         return LocalServer(params, lock=lock, device=device, granularity=granularity,
                            heartbeat_timeout=heartbeat_timeout)
     if mode == "http":
@@ -1237,7 +1320,8 @@ def make_server(
                           tracer=tracer, ops_port=ops_port,
                           role=role, shard_info=shard_info,
                           max_staleness=max_staleness,
-                          staleness_soft=staleness_soft)
+                          staleness_soft=staleness_soft,
+                          store_dir=store_dir)
     if mode == "socket":
         return SocketServer(params, lock=lock, port=port, device=device, host=host,
                             granularity=granularity, auth_key=auth_key,
@@ -1246,5 +1330,6 @@ def make_server(
                             tracer=tracer, ops_port=ops_port,
                             role=role, shard_info=shard_info,
                             max_staleness=max_staleness,
-                            staleness_soft=staleness_soft)
+                            staleness_soft=staleness_soft,
+                            store_dir=store_dir)
     raise ValueError(f"parameter_server_mode must be local|http|socket, got {mode!r}")

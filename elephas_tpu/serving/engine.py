@@ -252,6 +252,7 @@ class InferenceEngine:
         self._step_lock = threading.Lock()
         self._halted = False  # see halt(): a dead engine never steps again
         self.ops = None  # OpsServer, mounted on demand
+        self.store = None  # TelemetryStore, mounted with ops (store_dir=)
         # Canary exclusion: req_ids submitted with canary=True (guarded
         # by _cond). Their results still publish normally — the driver
         # retrieves them via result() — but never reach the goodput
@@ -799,7 +800,8 @@ class InferenceEngine:
             out.update(self.pool.prefix_stats())
         return out
 
-    def mount_ops(self, port: int = 0, host: Optional[str] = None):
+    def mount_ops(self, port: int = 0, host: Optional[str] = None,
+                  store_dir: Optional[str] = None):
         """Mount a live introspection endpoint (``obs.opsd``) for this
         engine: ``/metrics``, ``/healthz`` (+ queue/pool summary),
         ``/trace``, ``/vars``, ``/flight``, ``/alerts`` (stock SLO rule
@@ -809,6 +811,11 @@ class InferenceEngine:
         burn), ``/canary`` (blackbox probe SLIs when a driver is
         attached). Loopback-bound by default; port 0 picks a free one
         (read ``engine.ops.port``). Idempotent.
+
+        ``store_dir`` additionally mounts the durable telemetry journal
+        (``obs.store``): flight notes, alert transitions, sampler ticks,
+        and completed spans persist there for cross-process post-mortem
+        reconstruction (``/incidents`` serves its meta).
         """
         if self.ops is not None:
             return self.ops
@@ -820,6 +827,16 @@ class InferenceEngine:
             self._alert_engine = obs.AlertEngine()
         self._ops_history = obs.HistorySampler(
             extra_fn=record_device_memory).start()
+        self.store = None
+        if store_dir is not None:
+            self.store = obs.TelemetryStore(
+                store_dir, role="serving",
+                flight=obs.default_flight_recorder())
+            obs.default_flight_recorder().attach_store(self.store)
+            self._alert_engine.attach_store(self.store)
+            self._ops_history.attach_store(self.store)
+            if getattr(self.tracer, "enabled", False):
+                self.tracer.attach_store(self.store)
         self.ops = OpsServer(
             port=port, host=host, tracer=self.tracer,
             role="serving",
@@ -838,10 +855,12 @@ class InferenceEngine:
             load_fn=self.load.snapshot,
             slo_fn=self.slo.snapshot,
             canary_fn=self._canary_doc,
+            incidents_fn=(self.store.doc if self.store is not None
+                          else None),
         ).start()
         return self.ops
 
-    def unmount_ops(self) -> None:
+    def unmount_ops(self, reason: str = "close") -> None:
         if self.ops is not None:
             self.ops.stop()
             self.ops = None
@@ -849,6 +868,17 @@ class InferenceEngine:
         if sampler is not None:
             sampler.stop()
             self._ops_history = None
+        store = getattr(self, "store", None)
+        if store is not None:
+            from elephas_tpu import obs
+            obs.default_flight_recorder().detach_store(store)
+            engine = getattr(self, "_alert_engine", None)
+            if engine is not None:
+                engine.detach_store(store)
+            if hasattr(self.tracer, "detach_store"):
+                self.tracer.detach_store(store)
+            store.close(reason=reason)
+            self.store = None
 
 
 def shard_serving(engine: InferenceEngine, mesh, rules=None) -> InferenceEngine:

@@ -112,11 +112,16 @@ class Replica:
     def __init__(self, replica_id: str, engine_factory: Callable[[], Any],
                  *, clock: Callable[[], float] = time.monotonic,
                  mount_ops: bool = False,
+                 store_dir: Optional[str] = None,
                  canary_timeout_s: float = CANARY_TIMEOUT_S):
         self.replica_id = replica_id
         self.engine_factory = engine_factory
         self.clock = clock
         self.mount_ops = mount_ops
+        # Durable telemetry directory for every boot of this slot: each
+        # respawn reopens it under a fresh store boot id, which is
+        # exactly the cross-boot story the incident builder stitches.
+        self.store_dir = store_dir
         self.canary_timeout_s = canary_timeout_s
 
         self.engine = None
@@ -177,7 +182,7 @@ class Replica:
             name=f"replica:{self.replica_id}", daemon=True)
         self._thread.start()
         if self.mount_ops:
-            self.engine.mount_ops(port=0)
+            self.engine.mount_ops(port=0, store_dir=self.store_dir)
         self.state = SERVING
         return self
 
@@ -214,7 +219,7 @@ class Replica:
         if self.state == DEAD:
             return
         self.engine.halt()
-        self._stop_serving()
+        self._stop_serving(reason="kill")
         self.drained = False
         self.state = DEAD
 
@@ -232,14 +237,14 @@ class Replica:
             boot=self.boot, reason=reason)
         return self
 
-    def _stop_serving(self) -> None:
+    def _stop_serving(self, reason: str = "close") -> None:
         if self._stop is not None:
             self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
         if self.engine is not None and self.engine.ops is not None:
-            self.engine.unmount_ops()
+            self.engine.unmount_ops(reason=reason)
 
     # -- router bookkeeping ------------------------------------------------
 

@@ -13,8 +13,9 @@ the next boot number; a scale-up is a genuinely new slot.
 from __future__ import annotations
 
 import itertools
+import os
 import time
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 from elephas_tpu.serving.fleet.replica import DEAD, SERVING, Replica
 
@@ -33,12 +34,16 @@ class ReplicaSet:
     def __init__(self, engine_factory: Callable[[], Any], *,
                  initial: int = 1,
                  clock: Callable[[], float] = time.monotonic,
-                 mount_ops: bool = False):
+                 mount_ops: bool = False,
+                 store_root: Optional[str] = None):
         if initial < 1:
             raise ValueError(f"initial must be >= 1, got {initial}")
         self.engine_factory = engine_factory
         self.clock = clock
         self.mount_ops = mount_ops
+        # One durable telemetry slot dir per replica id under this root
+        # (requires mount_ops — the store mounts with the ops endpoint).
+        self.store_root = store_root
         self._seq = itertools.count()
         self.replicas: Dict[str, Replica] = {}
         for _ in range(initial):
@@ -47,8 +52,10 @@ class ReplicaSet:
     def spawn(self) -> Replica:
         """Add a new slot to the roster and boot it."""
         rid = f"r{next(self._seq)}"
+        store_dir = (os.path.join(self.store_root, rid, "telemetry")
+                     if self.store_root else None)
         rep = Replica(rid, self.engine_factory, clock=self.clock,
-                      mount_ops=self.mount_ops)
+                      mount_ops=self.mount_ops, store_dir=store_dir)
         rep.spawn()
         self.replicas[rid] = rep
         return rep

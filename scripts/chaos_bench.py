@@ -61,6 +61,14 @@ to the last acked state: zero acked-update loss), and the replay-stable
 drifts changed the data path). Both gated by ``bench_gate.py``
 (``shard_failover_mttr_s`` ceiling, ``acked_state_recovered`` equal).
 
+``--postmortem`` appends a ``{"scenario": "postmortem"}`` row: the
+shard-kill arc run with durable telemetry stores mounted next to every
+member's WAL, then EVERY process hard-killed and the incident rebuilt
+from the on-disk journals alone (``obs.incident``). Commits the
+replay-stable incident digest, the triggering event the reconstruction
+names (the shard kill), and the push-path persistence overhead of the
+mounted store — all gated.
+
 ``--staleness`` appends a ``{"scenario": "staleness"}`` row: a fully
 deterministic convergence-vs-staleness sweep over the wire admission
 path — the same seeded fast/slow-worker schedule run against
@@ -673,6 +681,163 @@ def scenario_shard_kill(seed: int = 11, k: int = 2, updates: int = 6):
             group.stop()
 
 
+def _store_push_overhead(seed: int = 11, updates: int = 40,
+                         rounds: int = 3, attempts: int = 3):
+    """Persistence overhead on the PS push path: seeded update loops
+    against two otherwise-identical servers — telemetry store mounted
+    vs disabled — alternating order, best-of-rounds, retried when the
+    measurement lands noisy (the ``lm_bench`` trace/canary overhead
+    methodology). No WAL on either side: WAL fsyncs dominate the push
+    wall and are identical noise in both arms — this isolates the
+    store mount's marginal cost on the path that must not pay one (the
+    store is off the hot path by design: pushes journal nothing; only
+    anomalies, alert transitions, and sampler ticks do)."""
+    import jax
+
+    from elephas_tpu.parameter.server import SocketServer
+
+    net = _build_net()
+    store0 = jax.device_get({"params": net.params,
+                             "batch_stats": net.batch_stats})
+    rng = np.random.default_rng(seed)
+    deltas = [jax.tree_util.tree_map(
+        lambda a: rng.normal(scale=0.01, size=np.shape(a))
+        .astype(np.asarray(a).dtype), store0) for _ in range(updates)]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        pairs = []  # (server, client) — [0] store on, [1] store off
+        for store_on in (True, False):
+            srv = SocketServer(
+                store0, port=0,
+                store_dir=os.path.join(tmp, "telemetry") if store_on
+                else None)
+            srv.start()
+            pairs.append((srv, srv.client()))
+        try:
+            def window(client) -> float:
+                t0 = time.perf_counter()
+                for delta in deltas:
+                    client.update_parameters(delta)
+                return updates / (time.perf_counter() - t0)
+
+            for _, client in pairs:  # connection + codec warmup
+                for delta in deltas[:5]:
+                    client.update_parameters(delta)
+            overhead = None
+            for _ in range(attempts):
+                on, off = [], []
+                for _ in range(rounds):
+                    on.append(window(pairs[0][1]))
+                    off.append(window(pairs[1][1]))
+                    off.append(window(pairs[1][1]))
+                    on.append(window(pairs[0][1]))
+                overhead = 1.0 - max(on) / max(off)
+                if overhead < 0.02:
+                    break
+        finally:
+            for srv, client in pairs:
+                client.close()
+                srv.stop()
+    return round(100.0 * overhead, 3)
+
+
+def scenario_postmortem(seed: int = 11, k: int = 2, updates: int = 6):
+    """``--postmortem``: the durable-telemetry acid test. Runs a
+    deterministic shard-kill arc with telemetry stores mounted next to
+    every member's WAL, hard-kills EVERY process (kill semantics — no
+    clean shutdown anywhere), then reconstructs the incident purely
+    from the on-disk journals with ``obs.incident.IncidentBuilder``
+    (what ``scripts/postmortem.py`` runs). The rebuilt timeline must
+    name the shard kill as the triggering event, and the incident
+    digest — a set digest over journaled event identities, immune to
+    timing-dependent repetition — must replay bit-identically; it is
+    pinned in BENCH_CHAOS.json and gated with an equal rule exactly
+    like the data-path ``final_digest``. Promotion is driven directly
+    (no monitor thread, no canary) so the journaled event SET is
+    deterministic run to run."""
+    import shutil
+
+    import jax
+
+    from elephas_tpu.obs.incident import IncidentBuilder
+    from elephas_tpu.parameter.group import ShardGroup
+
+    net = _build_net()
+    store0 = jax.device_get({"params": net.params,
+                             "batch_stats": net.batch_stats})
+    rng = np.random.default_rng(seed)
+    wal_root = tempfile.mkdtemp(prefix="chaos_postmortem_")
+    group = None
+    try:
+        group = ShardGroup(store0, k, mode="socket", standby=1,
+                           wal_root=wal_root, suspect_after=0.3)
+        group.start()
+        client = group.client()
+        try:
+            for _ in range(updates):
+                delta = jax.tree_util.tree_map(
+                    lambda a: rng.normal(
+                        scale=0.01, size=np.shape(a)
+                    ).astype(np.asarray(a).dtype), store0)
+                client.update_parameters(delta)
+        finally:
+            client.close()
+        deadline = time.perf_counter() + 10.0
+        while any(group.streamer_of(i) is not None
+                  and group.streamer_of(i).lag()
+                  for i in range(k)) and time.perf_counter() < deadline:
+            time.sleep(0.01)
+
+        # The incident: shard 0's primary crashes mid-traffic, its warm
+        # spare is promoted, then the WHOLE fleet is hard-killed — the
+        # post-mortem must work with every process gone.
+        group.kill_primary(0)
+        promoted = group.promote(0)
+        recovered = group.get_parameters() is not None
+        for shard in range(k):
+            group.kill_primary(shard)
+
+        def rebuild():
+            builder = IncidentBuilder()
+            builder.discover(wal_root)
+            return builder.build()
+
+        incident = rebuild()
+        replay = rebuild()
+        trigger = incident.get("triggering_event") or {}
+        corrupt = sum(p.get("corrupt_tails", 0)
+                      for p in incident["processes"])
+        row = {
+            "scenario": "postmortem", "shards": k, "standby": 1,
+            "updates_acked": updates,
+            "promoted": bool(promoted),
+            "recovered": bool(recovered),
+            # Rebuilt from disk alone, after every member was killed.
+            "postmortem_rebuilt": bool(incident["timeline"]),
+            "stores_discovered": incident["stores"],
+            "timeline_entries": len(incident["timeline"]),
+            "journal_records": sum(p["records"]
+                                   for p in incident["processes"]),
+            "corrupt_tails": corrupt,
+            "triggering_event": trigger.get("kind"),
+            "trigger_proc": trigger.get("proc"),
+            # bench_gate pins both ("equal"): the reconstruction must
+            # blame the shard kill, on the shard that was killed.
+            "trigger_is_shard_kill": (trigger.get("kind") == "ps_kill"
+                                      and trigger.get("proc") == "shard0"),
+            "incident_digest": incident["digest"],
+            "digest_replay_stable": incident["digest"] == replay["digest"],
+            "store_overhead_pct": _store_push_overhead(seed=seed),
+            "seed": seed,
+        }
+        row["store_overhead_within_2pct"] = row["store_overhead_pct"] <= 2.0
+        return row
+    finally:
+        if group is not None:
+            group.stop()
+        shutil.rmtree(wal_root, ignore_errors=True)
+
+
 def scenario_staleness(seed: int = 11, steps: int = 60):
     """``--staleness``: convergence vs the admission bound, measured
     through the real socket wire path, fully deterministic (single
@@ -866,6 +1031,12 @@ def main(argv=None):
                     help="append the shard-kill row: K=2 ShardGroup with "
                          "warm standbys, one primary crashed, measured "
                          "promotion MTTR + zero-acked-loss digest check")
+    ap.add_argument("--postmortem", action="store_true",
+                    help="append the post-mortem row: shard-kill arc "
+                         "with durable telemetry stores, every process "
+                         "hard-killed, incident rebuilt from disk alone "
+                         "(pinned replay-stable digest + triggering "
+                         "event + push-path persistence overhead)")
     ap.add_argument("--staleness", action="store_true",
                     help="append the bounded-staleness row: deterministic "
                          "convergence-vs-max_staleness sweep (∞/8/2) over "
@@ -896,6 +1067,8 @@ def main(argv=None):
         rows.append(scenario_health(x, y, args.epochs, seed=args.seed))
     if args.shards:
         rows.append(scenario_shard_kill(seed=args.seed))
+    if args.postmortem:
+        rows.append(scenario_postmortem(seed=args.seed))
     if args.staleness:
         rows.append(scenario_staleness(seed=args.seed))
     if args.fleet:

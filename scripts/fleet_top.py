@@ -6,7 +6,10 @@ process's opsd URL and get the merged picture — who is alive/stale/dead
 (with boot ids, so a warm restart is visible as the same slot coming
 back different), per-process LOAD (EWMA saturation score from ``/load``)
 and GOODPUT (worst-objective SLO attainment from ``/slo``; both render
-``-`` for stale/dead procs), the fleet-summed counters, pooled histogram
+``-`` for stale/dead procs), DISK (durable telemetry journal bytes from
+the federated ``obs_store_bytes`` gauge + seconds since the last
+persisted record via ``/incidents``; ``-`` when stale/dead or no store
+is mounted), the fleet-summed counters, pooled histogram
 percentiles, cluster worker ledger, and active alerts. A process whose
 ``/replicas`` roster is non-empty (a fleet router) also gets a replica
 board: per-replica lifecycle STATE, boot, LOAD, affinity hit-rate,
@@ -81,6 +84,37 @@ def _goodput_cell(snap: dict, name: str, status: str) -> str:
     return f"{100.0 * ratio:.1f}%" if ratio is not None else "-"
 
 
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "K", "M", "G"):
+        if n < 1024 or unit == "G":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}G"
+
+
+def _disk_cell(snap: dict, name: str, status: str) -> str:
+    """DISK column: the proc's durable telemetry footprint — journal
+    bytes from the federated ``obs_store_bytes`` gauge plus seconds
+    since it last persisted a record (from ``/incidents`` meta). A
+    stale/dead process renders '-': its last-known byte count says
+    nothing about whether the journal is still being written, and the
+    post-mortem CLI reads the store from disk anyway."""
+    if status != "alive":
+        return "-"
+    gauges = (snap.get("metrics") or {}).get("gauges") or {}
+    total = sum(v for k, v in gauges.items()
+                if k.startswith("obs_store_bytes{")
+                and f'proc="{name}"' in k)
+    meta = ((snap.get("incidents") or {}).get(name) or {}).get("meta") or {}
+    if not total and not meta:
+        return "-"
+    cell = _fmt_bytes(total)
+    age = meta.get("last_record_age_s")
+    if age is not None:
+        cell += f"/{age:.0f}s"
+    return cell
+
+
 def _sync_cell(row: dict) -> str:
     """SYNC column of the cluster worker ledger: the worker's
     self-reported adaptive units-per-push interval, with its rejected
@@ -130,7 +164,7 @@ def render(snap: dict) -> str:
     # ("ps/shard0", "ps/standby"), not just the flat "ps"/"worker".
     lines.append(f"{'NAME':<10} {'ROLE':<12} {'STATUS':<7} {'BOOT':<14} "
                  f"{'WORKER':<8} {'LAST OK':>8} {'LOAD':>5} {'GOODPUT':>8} "
-                 f"{'KV':>13}  URL")
+                 f"{'KV':>13} {'DISK':>11}  URL")
     for name, p in sorted(snap["processes"].items()):
         meta = p.get("meta") or {}
         ago = p.get("last_ok_s_ago")
@@ -141,7 +175,8 @@ def render(snap: dict) -> str:
             f"{('%.1fs' % ago) if ago is not None else '-':>8} "
             f"{_load_cell(snap, name, p['status']):>5} "
             f"{_goodput_cell(snap, name, p['status']):>8} "
-            f"{_kv_cell(snap, name, p['status']):>13}  {p['url']}"
+            f"{_kv_cell(snap, name, p['status']):>13} "
+            f"{_disk_cell(snap, name, p['status']):>11}  {p['url']}"
         )
     metrics = snap["metrics"]
     if metrics["counters"]:

@@ -250,6 +250,97 @@ def bench_trace_overhead(compiled, max_slots: int, prompt_len: int,
     return rec
 
 
+def bench_store_overhead(compiled, max_slots: int, prompt_len: int,
+                         new_tokens: int, requests: int,
+                         rounds: int = 3, attempts: int = 3) -> dict:
+    """Guardrail: the durable telemetry store must cost < 2% serving
+    throughput (the post-mortem plane's pitch is "persist everything,
+    pay nothing on the hot path").
+
+    Both arms mount the ops endpoint — history sampler ticking on its
+    daemon thread, alert engine scrapable — so the ONLY difference in
+    the measured arm is a mounted ``obs.TelemetryStore``: every sampler
+    tick, flight note, and alert transition journals to disk (write +
+    flush per record). Same discipline as the trace/canary overhead
+    gates: discarded warmup, alternating within-pair order, best-of-
+    ``rounds``, whole-measurement retries before the assert fires."""
+    import tempfile
+
+    import numpy as np
+
+    from elephas_tpu.serving import InferenceEngine
+
+    vocab = compiled.module.vocab_size
+
+    def run(store_dir):
+        rng = np.random.default_rng(1)
+        engine = InferenceEngine(
+            compiled,
+            max_slots=max_slots,
+            max_prompt_len=prompt_len,
+            max_len=prompt_len + new_tokens + 1,
+            queue_depth=max(requests, 1),
+            pipeline=True,
+        )
+        engine.mount_ops(port=0, store_dir=store_dir)
+        try:
+            engine.result(engine.submit([1] * prompt_len,
+                                        max_new_tokens=2))
+            t0 = time.perf_counter()
+            rids = []
+            for i in range(requests):
+                plen = int(rng.integers(1, prompt_len + 1))
+                prompt = rng.integers(1, vocab, plen).tolist()
+                rids.append(engine.submit(prompt,
+                                          max_new_tokens=new_tokens))
+                if len(rids) >= max_slots:
+                    engine.step()
+            results = [engine.result(r) for r in rids]
+            dt = time.perf_counter() - t0
+            tokens = sum(len(r.tokens) for r in results)
+            journaled = (engine.store.stats()["records"]
+                         if engine.store is not None else 0)
+            return tokens / dt, journaled
+        finally:
+            engine.unmount_ops()
+
+    with tempfile.TemporaryDirectory() as root:
+        dirs = iter(range(10000))  # fresh store dir per measured run
+
+        def on():
+            return run(os.path.join(root, f"s{next(dirs)}", "telemetry"))
+
+        run(None)  # warmup, discarded
+        for attempt in range(attempts):
+            plain, stored = [], []
+            for r in range(rounds):
+                if r % 2 == 0:
+                    plain.append(run(None)[0])
+                    stored.append(on())
+                else:
+                    stored.append(on())
+                    plain.append(run(None)[0])
+            overhead = 1.0 - max(s[0] for s in stored) / max(plain)
+            if overhead < 0.02:
+                break
+    rec = {
+        "mode": "serving_store_overhead",
+        "rounds": rounds,
+        "attempts_used": attempt + 1,
+        "tokens_per_sec_unstored": max(plain),
+        "tokens_per_sec_stored": max(s[0] for s in stored),
+        "journaled_records": max(s[1] for s in stored),
+        "overhead_pct": overhead * 100.0,
+        "within_2pct": overhead < 0.02,
+    }
+    assert rec["within_2pct"], (
+        f"telemetry store overhead {overhead * 100.0:.2f}% >= 2% after "
+        f"{attempts} attempts (stored {rec['tokens_per_sec_stored']:.0f} "
+        f"vs unstored {rec['tokens_per_sec_unstored']:.0f} tok/s)"
+    )
+    return rec
+
+
 def bench_slo(compiled, max_slots: int, prompt_len: int, new_tokens: int,
               requests: int, probes: int = 3, rounds: int = 3,
               attempts: int = 3) -> dict:
@@ -842,6 +933,11 @@ def main(argv=None) -> list:
     parser.add_argument("--no-overhead-check", action="store_true",
                         help="skip the traced-vs-untraced < 2%% guardrail "
                              "(6 extra serving runs)")
+    parser.add_argument("--store-overhead", action="store_true",
+                        help="append the durable-telemetry-store "
+                             "overhead row: serving throughput with the "
+                             "ops endpoint mounted, store vs no store "
+                             "(gated under 2%% like trace/canary)")
     parser.add_argument("--slo", action="store_true",
                         help="run the goodput + blackbox-canary arm "
                              "(SLO attainment ratios, canary probe SLIs, "
@@ -899,6 +995,14 @@ def main(argv=None) -> list:
         print(json.dumps(rec))
     if not args.no_overhead_check:
         rec = bench_trace_overhead(
+            compiled, args.serving_slots, args.prompt_len, args.new,
+            args.serving_requests,
+        )
+        serving_records.append(rec)
+        records.append(rec)
+        print(json.dumps(rec))
+    if args.store_overhead:
+        rec = bench_store_overhead(
             compiled, args.serving_slots, args.prompt_len, args.new,
             args.serving_requests,
         )

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""postmortem: rebuild an incident timeline from on-disk telemetry only.
+
+Every process that mounts a durable telemetry store (``obs.store``)
+journals its flight notes, alert transitions, sampler ticks, and span
+summaries as they happen. This CLI is the consumer for the case those
+processes are ALL gone — point it at the root the stores were mounted
+under (typically the chaos run's ``wal_root``) and it:
+
+- discovers every store directory under the root (``obs.store_dirs``),
+- clock-aligns the per-process journals (median wall-minus-mono base
+  per boot — the ``trace_report.merge_dumps`` clockSync idea, smoothed
+  against wall-clock steps),
+- correlates flight events, alert transitions, lifecycle marks, and
+  near-trigger metric excerpts into one causally-ordered timeline,
+  stitching warm restarts (same store directory, new boot id) into a
+  single per-process story,
+- names the triggering event (earliest error-severity entry) and prints
+  a replay-stable incident digest — rebuild the same journals twice and
+  the digest is identical, which is what the chaos bench pins.
+
+Usage:
+    python scripts/postmortem.py /path/to/wal_root
+    python scripts/postmortem.py /path/to/wal_root --out incident.md
+    python scripts/postmortem.py /path/to/wal_root --json incident.json
+
+Exit status is non-zero when no telemetry stores are found under the
+root — an empty post-mortem is a finding, not a report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from elephas_tpu.obs.incident import (  # noqa: E402
+    IncidentBuilder,
+    render_markdown,
+)
+
+
+def build_incident(root: str, metric_window_s: float = 2.0,
+                   title: str = "Incident report") -> Optional[dict]:
+    """Discover + build; None when the root holds no stores."""
+    builder = IncidentBuilder()
+    if not builder.discover(root):
+        return None
+    incident = builder.build(metric_window_s=metric_window_s)
+    incident["markdown"] = render_markdown(incident, title=title)
+    return incident
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Rebuild an incident bundle from on-disk telemetry "
+                    "stores (no live process required)")
+    ap.add_argument("root",
+                    help="directory tree the stores were mounted under "
+                         "(e.g. the chaos run's wal_root)")
+    ap.add_argument("--out", default=None,
+                    help="write the markdown timeline here "
+                         "(default: print to stdout)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the full incident bundle as JSON")
+    ap.add_argument("--metric-window", type=float, default=2.0,
+                    help="seconds of metric ticks to keep around the "
+                         "triggering event (default 2.0)")
+    args = ap.parse_args(argv)
+
+    incident = build_incident(args.root,
+                              metric_window_s=args.metric_window)
+    if incident is None:
+        print(f"postmortem: no telemetry stores under {args.root}",
+              file=sys.stderr)
+        return 1
+
+    markdown = incident.pop("markdown")
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(incident, fh, indent=1, sort_keys=True)
+        print(f"wrote {args.json_out}")
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(markdown)
+        print(f"wrote {args.out}")
+    else:
+        print(markdown)
+
+    trigger = incident.get("triggering_event")
+    kind = trigger["kind"] if trigger else "(none)"
+    print(f"\ndigest: {incident['digest']}  triggering event: {kind}  "
+          f"stores: {incident['stores']}  "
+          f"timeline entries: {len(incident['timeline'])}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

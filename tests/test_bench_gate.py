@@ -404,6 +404,64 @@ def test_fleet_autoscale_rules_are_exact():
     assert not stuck[("fleet_autoscale", "scaled_up_under_burst")]["ok"]
 
 
+def test_postmortem_rules_gate_digest_trigger_and_overhead():
+    """The --postmortem chaos row: the incident digest and triggering
+    event are exact (the arc is seeded and monitor-free, so the rebuilt
+    timeline is replay-stable across machines), the rebuild/stability/
+    trigger proof bits are exact, corrupt_tails must match the
+    committed zero, and the push-path persistence tax is an absolute
+    2% ceiling — baseline ignored, same discipline as the serving
+    trace guardrail."""
+    base = [{"scenario": "postmortem", "postmortem_rebuilt": True,
+             "digest_replay_stable": True,
+             "incident_digest": "9b929562d52d5a61",
+             "triggering_event": "ps_kill", "trigger_is_shard_kill": True,
+             "corrupt_tails": 0, "store_overhead_pct": 0.4,
+             "store_overhead_within_2pct": True}]
+    # Overhead drifting above baseline but under the ceiling passes.
+    assert all(c["ok"] for c in bg.compare(
+        base, [dict(base[0], store_overhead_pct=1.8)], "chaos"))
+    broken = bg.compare(base, [dict(
+        base[0], incident_digest="deadbeefdeadbeef",
+        triggering_event="alert", trigger_is_shard_kill=False,
+        digest_replay_stable=False, corrupt_tails=1,
+        store_overhead_pct=3.1, store_overhead_within_2pct=False)],
+        "chaos")
+    failed = sorted(c["metric"] for c in broken if not c["ok"])
+    assert failed == ["corrupt_tails", "digest_replay_stable",
+                      "incident_digest", "store_overhead_pct",
+                      "store_overhead_within_2pct",
+                      "trigger_is_shard_kill", "triggering_event"]
+    by = _checks_by_metric(broken)
+    assert by[("postmortem", "store_overhead_pct")]["threshold"] == \
+        "must be <= 2.0"
+    # Other chaos scenarios don't carry the post-mortem metrics.
+    other = [{"scenario": "baseline", "completed_units": 8}]
+    by = _checks_by_metric(bg.compare(other, other, "chaos"))
+    assert ("baseline", "incident_digest") not in by
+
+
+def test_store_overhead_serve_rules():
+    """The lm_bench --store-overhead row rides the existing 2% serving
+    overhead ceiling; within_2pct pins the bench's own verdict bit and
+    journaled_records must prove the store wrote during the timed
+    window (floor at 1 — an empty journal measures nothing)."""
+    base = [{"mode": "serving_store_overhead", "pipeline": None,
+             "overhead_pct": -1.8, "within_2pct": True,
+             "journaled_records": 20}]
+    # Fewer records than baseline is fine (floor, not baseline diff);
+    # negative overhead (store arm faster, noise) is under the ceiling.
+    assert all(c["ok"] for c in bg.compare(
+        base, [dict(base[0], journaled_records=3,
+                    overhead_pct=1.5)], "serve"))
+    by = _checks_by_metric(bg.compare(base, [dict(
+        base[0], overhead_pct=2.6, within_2pct=False,
+        journaled_records=0)], "serve"))
+    assert not by[("serving_store_overhead", "overhead_pct")]["ok"]
+    assert not by[("serving_store_overhead", "within_2pct")]["ok"]
+    assert not by[("serving_store_overhead", "journaled_records")]["ok"]
+
+
 def test_prefix_rules_gate_hit_rate_identity_and_itl_tail():
     """The lm_bench --prefix row: hit rate is an absolute floor (0.5),
     paged-vs-contiguous token identity is exact, and the chunked/

@@ -1,0 +1,405 @@
+"""Durable telemetry store (``obs/store.py``) + post-mortem incident
+reconstruction (``obs/incident.py``, ``scripts/postmortem.py``).
+
+The failure modes the store exists for are exercised directly: a torn
+segment tail is walked past by readers and truncated (loudly) on warm
+reopen, the disk budget prunes oldest-first per boot with the
+``obs_store_bytes`` gauge tracking reality, a warm restart stitches
+into one per-process story, and the whole journal replays
+byte-identically under injected clocks — which is what makes the
+incident digest pinnable in the chaos bench.
+"""
+
+import json
+import struct
+from pathlib import Path
+
+import pytest
+
+from elephas_tpu.obs import (
+    FlightRecorder,
+    IncidentBuilder,
+    MetricsRegistry,
+    TelemetryStore,
+    Tracer,
+    iter_records,
+    read_store,
+    store_dirs,
+)
+from elephas_tpu.obs import store as store_mod
+from elephas_tpu.obs.fleet import FleetAggregator
+from elephas_tpu.obs.opsd import ROUTES, OpsServer
+
+
+def _segments(directory):
+    return sorted(Path(directory).glob("seg-*.etj"))
+
+
+# --------------------------------------------------------------------------
+# Append path + vocabulary
+# --------------------------------------------------------------------------
+
+
+def test_record_vocab_and_boot_lifecycle(tmp_path):
+    store = TelemetryStore(str(tmp_path), role="ps", boot="b0")
+    with pytest.raises(ValueError):
+        store.record("bogus", {})
+    rec = store.record("flight", {"kind": "x"}, severity="warn")
+    assert rec["role"] == "ps" and rec["boot"] == "b0"
+    store.close()
+    dump = read_store(str(tmp_path))
+    # boot lifecycle, the flight record, close lifecycle — in order.
+    kinds = [(r["k"], r["data"].get("event") or r["data"].get("kind"))
+             for r in dump["records"]]
+    assert kinds == [("lifecycle", "boot"), ("flight", "x"),
+                     ("lifecycle", "close")]
+    assert dump["corrupt_tails"] == []
+
+
+def test_record_after_close_is_dropped_not_raised(tmp_path):
+    """Teed surfaces outlive the store on kill paths — a late note must
+    be swallowed, never crash the host or reopen the file."""
+    store = TelemetryStore(str(tmp_path), boot="b0")
+    store.close(reason="kill")
+    assert store.record("flight", {"kind": "late"}) is None
+    store.close()  # idempotent
+    records = iter_records(str(tmp_path))[0]
+    assert [r["data"].get("event") for r in records if r["k"] == "lifecycle"
+            ] == ["boot", "kill"]
+
+
+# --------------------------------------------------------------------------
+# Corrupt tail: readers walk past, warm reopen truncates loudly
+# --------------------------------------------------------------------------
+
+
+def test_corrupt_tail_walked_past_and_truncated_on_reopen(tmp_path):
+    store = TelemetryStore(str(tmp_path), role="ps", boot="boot-a")
+    for i in range(3):
+        store.record("flight", {"kind": f"ev{i}"})
+    store.sync()
+    # Simulate SIGKILL mid-append: a torn frame (magic + length, body
+    # cut short) lands at the tail; the process never runs close().
+    seg = _segments(tmp_path)[-1]
+    good_size = seg.stat().st_size
+    with open(seg, "ab") as f:
+        f.write(b"ETJ1" + struct.pack("!I", 4096) + b"torn")
+
+    # Readers tolerate the tail: all real records decode, the segment
+    # is reported corrupt, nothing raises.
+    records, corrupt = iter_records(str(tmp_path))
+    assert [r["data"]["kind"] for r in records if r["k"] == "flight"] == \
+        ["ev0", "ev1", "ev2"]
+    assert corrupt == [str(seg)]
+
+    # Warm reopen under a NEW boot heals the dead boot's tail: the file
+    # is truncated back to the last frame boundary and the healing is
+    # noted as a store_corrupt_tail flight event.
+    flight = FlightRecorder(capacity=8)
+    store2 = TelemetryStore(str(tmp_path), role="ps", boot="boot-b",
+                            flight=flight)
+    assert seg.stat().st_size == good_size
+    assert store2.stats()["healed_tails"] == 1
+    events = flight.snapshot()["events"]
+    heal = [e for e in events if e["kind"] == "store_corrupt_tail"]
+    assert len(heal) == 1 and heal[0]["severity"] == "warn"
+    assert heal[0]["detail"]["path"] == seg.name
+    store2.close()
+    assert iter_records(str(tmp_path))[1] == []  # healed: no corrupt tails
+
+
+def test_heal_never_touches_own_boot_segments(tmp_path):
+    """The tail walk only truncates FOREIGN boots' segments — the open
+    path must never eat bytes a concurrent self could still own."""
+    store = TelemetryStore(str(tmp_path), boot="boot-a")
+    store.record("flight", {"kind": "mine"})
+    store.sync()
+    seg = _segments(tmp_path)[-1]
+    with open(seg, "ab") as f:
+        f.write(b"garbage-tail")
+    size = seg.stat().st_size
+    again = TelemetryStore(str(tmp_path), boot="boot-a")
+    assert seg.stat().st_size == size  # same boot: untouched
+    assert again.stats()["healed_tails"] == 0
+    again.close()
+
+
+# --------------------------------------------------------------------------
+# Disk budget: rotation + oldest-first pruning + gauge
+# --------------------------------------------------------------------------
+
+
+def test_rotation_prunes_oldest_first_and_gauge_tracks_disk(tmp_path):
+    registry = MetricsRegistry()
+    store = TelemetryStore(str(tmp_path), role="ps", boot="b0", keep=2,
+                           segment_bytes=1024, registry=registry)
+    for i in range(40):
+        store.record("flight", {"kind": "spam", "detail": {"pad": "x" * 150,
+                                                           "i": i}})
+    stats = store.stats()
+    assert stats["rotations"] > 0 and stats["pruned_segments"] > 0
+    segs = _segments(tmp_path)
+    assert len(segs) <= 2  # keep-N bound holds on disk
+    # Oldest-first: the surviving seqs are the HIGHEST ones.
+    seqs = sorted(int(p.name.split("-")[1]) for p in segs)
+    assert seqs[0] == stats["segments"] - len(segs)
+    # The gauge is the fleet's view of the same bytes.
+    gauge = registry.gauge("obs_store_bytes", labelnames=("role",))
+    assert gauge.labels(role="ps").value == float(store.disk_bytes())
+    store.close()
+
+
+def test_prune_spares_foreign_boot_evidence(tmp_path):
+    """A restarted process on the same slot must not eat its dead
+    predecessor's journal beyond its own budget: pruning is per-boot."""
+    old = TelemetryStore(str(tmp_path), boot="boot-dead")
+    old.record("flight", {"kind": "evidence"})
+    old.sync()  # abandoned, never closed — SIGKILL
+    n_old = len(_segments(tmp_path))
+    new = TelemetryStore(str(tmp_path), boot="boot-live", keep=1,
+                         segment_bytes=1024)
+    for i in range(40):
+        new.record("flight", {"kind": "spam", "detail": {"pad": "x" * 150}})
+    new.close()
+    survivors = {p.name for p in _segments(tmp_path)}
+    assert sum("boot-dead" in n for n in survivors) == n_old
+    assert sum("boot-live" in n for n in survivors) <= 1
+    # And the predecessor's records still read back.
+    records = iter_records(str(tmp_path))[0]
+    assert any(r["k"] == "flight" and r["data"]["kind"] == "evidence"
+               for r in records)
+
+
+# --------------------------------------------------------------------------
+# Cross-boot stitching + replay-stable rebuild
+# --------------------------------------------------------------------------
+
+
+def test_warm_restart_stitches_into_one_process_story(tmp_path):
+    slot = tmp_path / "ps0" / "telemetry"
+    first = TelemetryStore(str(slot), role="ps", boot="boot-1")
+    first.record("flight", {"kind": "wal_restore"})
+    first.close()
+    second = TelemetryStore(str(slot), role="ps", boot="boot-2")
+    second.record("flight", {"kind": "resumed"})
+    second.close()
+
+    builder = IncidentBuilder()
+    assert builder.discover(str(tmp_path)) == ["ps0"]
+    incident = builder.build()
+    assert incident["stores"] == 1
+    (proc,) = incident["processes"]
+    assert proc["name"] == "ps0" and len(proc["boots"]) == 2
+    assert incident["boots_by_proc"]["ps0"] == ["boot-1", "boot-2"]
+    # The second boot's lifecycle record reads as a warm restart and
+    # the timeline is one causally ordered story across both boots.
+    names = [e["name"] for e in incident["timeline"]]
+    assert names == ["boot", "wal_restore", "close",
+                     "boot (warm restart)", "resumed", "close"]
+
+
+def test_journal_replays_byte_identical_under_injected_clocks(
+        tmp_path, monkeypatch):
+    """Same injected clocks + same records ⇒ the same bytes on disk and
+    the same incident digest — the property the chaos bench pins."""
+
+    def run(directory):
+        state = {"wall": 1.7e9, "mono": 50.0}
+
+        def wall():
+            state["wall"] += 0.25
+            return state["wall"]
+
+        def mono():
+            state["mono"] += 0.25
+            return state["mono"]
+
+        monkeypatch.setattr(store_mod.time, "time", wall)
+        store = TelemetryStore(str(directory), role="ps", boot="replay",
+                               clock=mono)
+        store.record("flight", {"kind": "ps_kill",
+                                "detail": {"shard": 0}}, severity="error")
+        store.record("alert", {"rule": "push_stall", "transition": "fire"},
+                     severity="warn")
+        store.record("metric", {"values": {"q": 1.0}, "tick": 0})
+        store.close(reason="kill")
+        monkeypatch.undo()
+        return b"".join(p.read_bytes() for p in _segments(directory))
+
+    blob_a = run(tmp_path / "a")
+    blob_b = run(tmp_path / "b")
+    assert blob_a == blob_b and len(blob_a) > 0
+
+    def digest(d):
+        b = IncidentBuilder()
+        b.add_store(str(d), name="ps")
+        return b.build()["digest"]
+
+    assert digest(tmp_path / "a") == digest(tmp_path / "b")
+
+
+def test_digest_is_order_canonical_not_timing_sensitive(tmp_path):
+    """Two runs of the 'same incident' with different wall times, boot
+    ids, and event ORDER produce the same digest: it hashes the sorted
+    set of stable identities, never the schedule."""
+
+    def run(directory, boot, order):
+        store = TelemetryStore(str(directory), role="ps", boot=boot)
+        for kind, sev in order:
+            store.record("flight", {"kind": kind, "detail": {}},
+                         severity=sev)
+        store.close()
+
+    run(tmp_path / "a", "boot-x", [("ps_kill", "error"),
+                                   ("wal_restore", "info")])
+    run(tmp_path / "b", "boot-y", [("wal_restore", "info"),
+                                   ("ps_kill", "error")])
+
+    def build(d):
+        b = IncidentBuilder()
+        b.add_store(str(d), name="ps")
+        return b.build()
+
+    a, b = build(tmp_path / "a"), build(tmp_path / "b")
+    assert a["digest"] == b["digest"]
+    # The trigger is severity-ranked, not order-ranked: both runs name
+    # the error event even though run b journaled it second.
+    assert a["triggering_event"]["kind"] == "ps_kill"
+    assert b["triggering_event"]["kind"] == "ps_kill"
+
+
+def test_cross_store_dedup_attributes_by_boot_path_then_driver(tmp_path):
+    """One shared flight recorder teeing into N co-hosted stores: each
+    anomaly keeps exactly one attributed copy — to the store whose boot
+    the detail names, else whose slot dir the detail's path enters,
+    else to the synthetic (shared)/driver slot."""
+    flight = FlightRecorder(capacity=16)
+    s0 = TelemetryStore(str(tmp_path / "ps0" / "telemetry"), role="ps",
+                        boot="b-ps0")
+    s1 = TelemetryStore(str(tmp_path / "ps1" / "telemetry"), role="ps",
+                        boot="b-ps1")
+    flight.attach_store(s0)
+    flight.attach_store(s1)
+    flight.note("ps_kill", "error", boot="b-ps1")            # boot key
+    flight.note("wal_restore", "info",
+                wal_dir=str(tmp_path / "ps0"))               # path key
+    flight.note("worker_requeue", "warn", unit=3)            # neither
+    s0.close()
+    s1.close()
+
+    builder = IncidentBuilder()
+    builder.discover(str(tmp_path))
+    incident = builder.build()
+    assert incident["deduped_flight"] == 3  # one dropped copy per event
+    by_kind = {e["name"]: e for e in incident["timeline"]
+               if e["k"] == "flight"}
+    assert len(by_kind) == 3
+    assert by_kind["ps_kill"]["proc"] == "ps1"
+    assert by_kind["wal_restore"]["proc"] == "ps0"
+    assert by_kind["worker_requeue"]["proc"] == "(shared)"
+    assert by_kind["worker_requeue"]["role"] == "driver"
+
+
+def test_postmortem_cli_rebuilds_from_disk_only(tmp_path, capsys):
+    import scripts.postmortem as pm
+
+    slot = tmp_path / "root" / "ps0" / "telemetry"
+    store = TelemetryStore(str(slot), role="ps", boot="b0")
+    store.record("flight", {"kind": "ps_kill", "detail": {"shard": 0}},
+                 severity="error")
+    store.close(reason="kill")
+
+    out_json = tmp_path / "incident.json"
+    rc = pm.main([str(tmp_path / "root"), "--json", str(out_json)])
+    assert rc == 0
+    bundle = json.loads(out_json.read_text())
+    assert bundle["triggering_event"]["kind"] == "ps_kill"
+    assert bundle["stores"] == 1
+    md = capsys.readouterr().out
+    assert "ps_kill" in md and "←trigger" in md
+    # An empty root is a finding, not a report.
+    assert pm.main([str(tmp_path / "empty")]) == 1
+
+
+# --------------------------------------------------------------------------
+# Ops surface: /incidents route + fleet federation + fleet_top DISK
+# --------------------------------------------------------------------------
+
+
+def test_incidents_route_serves_store_doc(tmp_path):
+    assert "/incidents" in ROUTES
+    import urllib.request
+
+    store = TelemetryStore(str(tmp_path), role="ps", boot="b0")
+    store.record("flight", {"kind": "wal_restore"})
+    server = OpsServer(port=0, registry=MetricsRegistry(),
+                       tracer=Tracer(annotate_device=False),
+                       flight=FlightRecorder(capacity=4),
+                       incidents_fn=store.doc)
+    server.start()
+    try:
+        with urllib.request.urlopen(f"{server.url}/incidents",
+                                    timeout=5.0) as resp:
+            doc = json.loads(resp.read())
+        assert doc["meta"]["role"] == "ps"
+        assert doc["meta"]["records"] == 2  # boot lifecycle + flight
+        assert [r["k"] for r in doc["recent"]] == ["lifecycle", "flight"]
+    finally:
+        server.stop()
+        store.close()
+    # No store mounted → the route still serves, empty.
+    bare = OpsServer(port=0, registry=MetricsRegistry(),
+                     tracer=Tracer(annotate_device=False),
+                     flight=FlightRecorder(capacity=4))
+    bare.start()
+    try:
+        with urllib.request.urlopen(f"{bare.url}/incidents",
+                                    timeout=5.0) as resp:
+            assert json.loads(resp.read()) == {"meta": None, "recent": []}
+    finally:
+        bare.stop()
+
+
+def test_fleet_federates_store_meta_and_disk_cell_renders(tmp_path):
+    import scripts.fleet_top as fleet_top
+
+    metrics = ("# TYPE obs_store_bytes gauge\n"
+               'obs_store_bytes{role="ps"} 2048\n')
+    incidents = {"meta": {"role": "ps", "bytes": 2048,
+                          "last_record_age_s": 3.0}, "recent": []}
+    bodies = {
+        "/meta": json.dumps({"role": "ps", "boot": "b0"}).encode(),
+        "/metrics": metrics.encode(),
+        "/workers": json.dumps({"workers": {}, "total_updates": 0,
+                                "unstamped_updates": 0}).encode(),
+        "/alerts": json.dumps({"rules": [], "active": [], "fired": [],
+                               "fired_kinds": []}).encode(),
+        "/incidents": json.dumps(incidents).encode(),
+    }
+
+    def fetch(url, timeout):
+        return bodies[url[len("http://ps"):]]
+
+    agg = FleetAggregator(clock=lambda: 0.0, fetch=fetch)
+    agg.add("http://ps", name="ps")
+    agg.poll(now=0.0)
+    snap = agg.snapshot(now=0.0)
+    assert snap["incidents"]["ps"]["meta"]["bytes"] == 2048
+    # The federated gauge is per-proc (proc label), never fleet-summed.
+    assert any(k.startswith("obs_store_bytes{") and 'proc="ps"' in k
+               for k in snap["metrics"]["gauges"])
+    assert fleet_top._disk_cell(snap, "ps", "alive") == "2.0K/3s"
+    # Stale/dead procs and procs with no store render '-'.
+    assert fleet_top._disk_cell(snap, "ps", "stale") == "-"
+    assert fleet_top._disk_cell(snap, "other", "alive") == "-"
+    board = fleet_top.render(snap)
+    assert "DISK" in board and "2.0K/3s" in board
+
+
+def test_store_dirs_discovery_ignores_foreign_files(tmp_path):
+    (tmp_path / "a" / "telemetry").mkdir(parents=True)
+    (tmp_path / "a" / "telemetry" / "seg-00000000-b0.etj").write_bytes(b"")
+    (tmp_path / "b").mkdir()
+    (tmp_path / "b" / "notes.txt").write_text("not a segment")
+    (tmp_path / "b" / "seg-junk.etj").write_bytes(b"")  # unparseable name
+    assert store_dirs(str(tmp_path)) == [str(tmp_path / "a" / "telemetry")]
