@@ -1,0 +1,23 @@
+"""Static-analysis subsystem: rule registry over a parse-once repo view.
+
+Grown out of ``scripts/lint_blocking.py`` (now a shim): the eight
+legacy lint domains plus the concurrency analyzers (``lock-order``,
+``lock-blocking``) and the ``dead-pragma`` audit. Run with
+``python -m elephas_tpu.analysis``; see ``--list-rules``.
+"""
+
+from elephas_tpu.analysis.core import (PRAGMAS, Finding, Repo, Rule,
+                                       SourceFile, suppressions, violations)
+from elephas_tpu.analysis.cli import (build_report, build_rules, main,
+                                      run_rules)
+from elephas_tpu.analysis.locks import (BlockingUnderLockRule, LockAnalysis,
+                                        LockOrderRule, get_analysis)
+from elephas_tpu.analysis.pragmas import DeadPragmaRule
+
+__all__ = [
+    "PRAGMAS", "Finding", "Repo", "Rule", "SourceFile",
+    "suppressions", "violations",
+    "build_report", "build_rules", "main", "run_rules",
+    "BlockingUnderLockRule", "LockAnalysis", "LockOrderRule",
+    "get_analysis", "DeadPragmaRule",
+]

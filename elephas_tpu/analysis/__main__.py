@@ -1,0 +1,5 @@
+import sys
+
+from elephas_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from elephas_tpu.obs.flight import KINDS
 from elephas_tpu.obs.history import HistoryRing
+from elephas_tpu.utils import locksan
 
 __all__ = ["AlertEngine", "AlertRule", "RULE_NAMES", "default_rules"]
 
@@ -177,7 +178,7 @@ class AlertEngine:
         self._flight = flight
         self.rules = list(rules) if rules is not None else default_rules()
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("AlertEngine._lock")
         # (rule.name, key) → consecutive trip count / latched breach.
         self._trips: Dict[Tuple[str, str], int] = {}
         self._breached: Dict[Tuple[str, str], bool] = {}

@@ -33,6 +33,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from elephas_tpu.obs import trace as _trace
+from elephas_tpu.utils import locksan
 
 __all__ = ["FlightEvent", "FlightRecorder", "KINDS", "NULL_FLIGHT_RECORDER"]
 
@@ -128,7 +129,7 @@ class FlightRecorder:
         self.clock = clock
         self.dropped = 0
         self._events: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("FlightRecorder._lock")
         self._dropped_counter = None  # lazily bound on first overwrite
         self._stores: tuple = ()  # durable tees (obs/store.py), COW
 

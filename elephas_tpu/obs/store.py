@@ -48,6 +48,7 @@ import time
 from collections import deque
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
+from elephas_tpu.utils import locksan
 
 __all__ = [
     "TelemetryStore", "iter_records", "read_store", "scan_segment",
@@ -207,7 +208,7 @@ class TelemetryStore:
         self.flight = flight
         self._registry = registry
         self._gauge = None  # lazily bound (mirrors flight's drop counter)
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("TelemetryStore._lock")
         self._seq = 0          # record sequence, this boot
         self._records = 0
         self._rotations = 0
@@ -306,7 +307,7 @@ class TelemetryStore:
                 self._rotate_locked()
             try:
                 self._fh.write(frame)
-                self._fh.flush()
+                self._fh.flush()  # lock-ok: single-writer journal; write+flush is the record boundary
             except OSError:
                 return None  # disk gone: telemetry must never crash hosts
             self._seg_bytes += len(frame)
@@ -322,8 +323,8 @@ class TelemetryStore:
         """Seal the current segment (fsync — it becomes durable against
         machine crash, not just process death) and open the next."""
         try:
-            self._fh.flush()
-            os.fsync(self._fh.fileno())
+            self._fh.flush()  # lock-ok: segment seal; rotation must be atomic wrt writers
+            os.fsync(self._fh.fileno())  # lock-ok: segment seal fsync
         except OSError:
             pass
         self._fh.close()
@@ -429,8 +430,8 @@ class TelemetryStore:
             if self._closed:
                 return
             try:
-                self._fh.flush()
-                os.fsync(self._fh.fileno())
+                self._fh.flush()  # lock-ok: durability barrier, serialized with writers by design
+                os.fsync(self._fh.fileno())  # lock-ok: durability barrier
             except OSError:
                 pass
 
@@ -445,8 +446,8 @@ class TelemetryStore:
                 return
             self._closed = True
             try:
-                self._fh.flush()
-                os.fsync(self._fh.fileno())
+                self._fh.flush()  # lock-ok: final seal before close
+                os.fsync(self._fh.fileno())  # lock-ok: final seal before close
             except OSError:
                 pass
             self._fh.close()

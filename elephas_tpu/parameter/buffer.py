@@ -42,6 +42,7 @@ from typing import Optional
 
 import jax
 
+from elephas_tpu.utils import locksan
 from elephas_tpu.utils.functional_utils import subtract_params
 from elephas_tpu.utils.rwlock import NullLock, RWLock
 
@@ -64,12 +65,12 @@ class ParameterBuffer:
         if granularity not in ("tree", "leaf"):
             raise ValueError(f"granularity must be tree|leaf, got {granularity!r}")
         self._device = device if device is not None else jax.devices()[0]
-        self._lock = RWLock() if lock else NullLock()
+        self._lock = RWLock(name="ParameterBuffer._lock") if lock else NullLock()
         self._apply = jax.jit(subtract_params)
         self._apply_leaf = jax.jit(lambda a, b: a - b)
         self._granularity = granularity
         self._version = 0
-        self._version_guard = threading.Lock()
+        self._version_guard = locksan.make_lock("ParameterBuffer._version_guard")
         params = jax.device_put(params, self._device)
         if granularity == "leaf":
             # Per-leaf SLOTS: each leaf lives under its own dict key, and

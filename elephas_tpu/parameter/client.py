@@ -613,11 +613,11 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
         for retry in (idempotent, False):
             sock = self._connection()
             try:
-                nonce = socket_utils.send(sock, frame, key=self.auth_key)
+                nonce = socket_utils.send(sock, frame, key=self.auth_key)  # lock-ok: the conn lock exists to serialize this socket (one in-flight request)
                 # Reply MAC is bound to OUR request nonce (mirrors the
                 # HTTP transport): a captured server response can't be
                 # replayed into a different exchange.
-                return socket_utils.receive(sock, key=self.auth_key, bind=nonce)
+                return socket_utils.receive(sock, key=self.auth_key, bind=nonce)  # lock-ok: reply read is the second half of the serialized exchange
             except (socket.timeout, TimeoutError) as exc:
                 # Read timeout on an ESTABLISHED connection: the server is
                 # wedged, not restarting — another ``timeout``-long attempt

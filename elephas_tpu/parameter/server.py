@@ -46,6 +46,7 @@ from elephas_tpu.parameter import wire
 from elephas_tpu.parameter.base import BaseParameterServer
 from elephas_tpu.parameter.buffer import ParameterBuffer
 from elephas_tpu.utils import sockets as socket_utils
+from elephas_tpu.utils import locksan
 
 
 def _ps_counters(transport: str):
@@ -330,7 +331,7 @@ class _SnapshotCache:
     def __init__(self, buffer: ParameterBuffer, boot: Optional[str] = None):
         self._buffer = buffer
         self._boot = boot  # stamped into packed headers (see _new_boot_id)
-        self._encode_lock = threading.Lock()
+        self._encode_lock = locksan.make_lock("_SnapshotCache._encode_lock")
         self._entries: dict = {}  # codec -> (version, frames|bytes)
 
     def frames(self, codec: str):
@@ -343,9 +344,9 @@ class _SnapshotCache:
                 return entry
             version, snap = self._buffer.get_numpy_with_version()
             if codec == "packed":
-                payload = wire.encode_tree(snap, version=version, boot=self._boot)
+                payload = wire.encode_tree(snap, version=version, boot=self._boot)  # lock-ok: single-flight encode; the lock exists to dedupe this work
             else:
-                payload = wire.encode_pickle(snap)
+                payload = wire.encode_pickle(snap)  # lock-ok: single-flight encode
             entry = (version, payload)
             self._entries[codec] = entry
             return entry

@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, Optional
 from elephas_tpu import obs
 from elephas_tpu.obs.alerts import AlertEngine, default_rules
 from elephas_tpu.obs.canary import CanaryDriver
+from elephas_tpu.utils import locksan
 
 __all__ = ["DEAD", "DRAINING", "LIFECYCLES", "Replica", "ReplicaDead",
            "SERVING"]
@@ -144,7 +145,7 @@ class Replica:
         self.shedding = False
 
         self.in_flight = 0
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("Replica._lock")
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
         self._alerts: Optional[AlertEngine] = None

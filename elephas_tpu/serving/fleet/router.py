@@ -51,6 +51,7 @@ from elephas_tpu.serving.fleet.replica import (
 )
 from elephas_tpu.serving.fleet.replica_set import ReplicaSet
 from elephas_tpu.serving.scheduler import QueueFull
+from elephas_tpu.utils import locksan
 
 __all__ = ["FleetUnavailable", "Router"]
 
@@ -127,7 +128,7 @@ class Router:
         self.slo = GoodputLedger(clock=self.clock)
 
         self._ids = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("Router._lock")
         self._assignments: Dict[int, _Assignment] = {}
         self._sessions: Dict[str, str] = {}
         self._affinity: Dict[str, Dict[str, int]] = {}

@@ -257,7 +257,7 @@ class BlockTable:
     def device(self):
         if self._dev is None:
             host = np.where(self.rows < 0, self.num_blocks, self.rows)
-            dev = jnp.asarray(  # host-ok: host table → device upload
+            dev = jnp.asarray(  # host table → device upload
                 host.astype(np.int32)
             )
             if self.sharding is not None:

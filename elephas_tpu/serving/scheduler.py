@@ -48,6 +48,7 @@ import numpy as np
 
 from elephas_tpu import obs
 from elephas_tpu.serving import host_sync
+from elephas_tpu.utils import locksan
 
 
 class QueueFull(RuntimeError):
@@ -103,7 +104,7 @@ class RequestQueue:
         self.max_depth = max_depth
         self.retry_hint_s = retry_hint_s
         self._items: List[Request] = []
-        self._lock = threading.Lock()
+        self._lock = locksan.make_lock("RequestQueue._lock")
 
     def submit(self, request: Request) -> None:
         with self._lock:
@@ -403,7 +404,7 @@ class ContinuousBatchingScheduler:
                 continue
             plen = len(req.prompt)
             pad = self.max_prompt_len - plen
-            padded = jnp.asarray(  # host-ok: host list → device upload
+            padded = jnp.asarray(  # host list → device upload
                 [[self.pad_token] * pad + list(req.prompt)], jnp.int32
             )
             t_pre0 = self.clock()
@@ -483,7 +484,7 @@ class ContinuousBatchingScheduler:
         self.pool.ensure_cols(pf.slot, start + valid)
         chunk = list(req.prompt[start:start + valid])
         chunk += [self.pad_token] * (self.prefill_chunk - valid)
-        tokens = jnp.asarray(  # host-ok: host list → device upload
+        tokens = jnp.asarray(  # host list → device upload
             [chunk], jnp.int32
         )
         pf.first_dev = self.chunk_prefill_fn(

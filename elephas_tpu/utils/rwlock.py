@@ -16,23 +16,36 @@ from __future__ import annotations
 import threading
 from contextlib import contextmanager
 
+from elephas_tpu.utils import locksan
+
 
 class RWLock:
-    """Writer-preferring readers-writer lock with the reference's API."""
+    """Writer-preferring readers-writer lock with the reference's API.
 
-    def __init__(self):
+    ``name`` opts the lock into the runtime sanitizer
+    (:mod:`elephas_tpu.utils.locksan`) under its STATIC identity (the
+    ``Class.attr`` the analyzer derives); the whole RWLock is one node
+    in the order graph regardless of read/write side. The internal
+    condition stays untracked — it is released before any user code
+    runs, so it can never participate in an inversion.
+    """
+
+    def __init__(self, name: str | None = None):
         self._cond = threading.Condition(threading.Lock())
         self._readers = 0
         self._writer = False
         self._writers_waiting = 0
+        self._san_name = name
 
     def acquire_read(self):
+        locksan.rw_acquire(self._san_name, write=False)
         with self._cond:
             while self._writer or self._writers_waiting:
                 self._cond.wait()
             self._readers += 1
 
     def acquire_write(self):
+        locksan.rw_acquire(self._san_name, write=True)
         with self._cond:
             self._writers_waiting += 1
             try:
@@ -52,6 +65,7 @@ class RWLock:
             else:
                 raise RuntimeError("release() without a held lock")
             self._cond.notify_all()
+        locksan.rw_release(self._san_name)
 
     @contextmanager
     def reading(self):

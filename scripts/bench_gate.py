@@ -185,6 +185,27 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             ("store_overhead_within_2pct", "equal", 0.0),
         ],
     ),
+    "analysis": (
+        ("section",),
+        [
+            # Static-analysis gate (ANALYSIS.json `rows`): zero
+            # unsuppressed violations is an absolute ceiling — a fresh
+            # finding fails the gate no matter what the committed
+            # baseline says. Suppression counts are exact per rule: a
+            # NEW pragma (someone silencing a finding) and a VANISHED
+            # one (an escape rotted away) both surface as a diff that
+            # has to be re-committed deliberately. Same discipline for
+            # the lock graph: a fresh lock-order cycle is an absolute
+            # fail, and the graph's shape (lock and edge counts) moving
+            # means the concurrency structure changed — re-baseline
+            # consciously.
+            ("violations", "limit", 0.0),
+            ("suppressions", "equal", 0.0),
+            ("lock_cycles", "limit", 0.0),
+            ("locks", "equal", 0.0),
+            ("lock_edges", "equal", 0.0),
+        ],
+    ),
     "fleet": (
         ("mode",),
         [
@@ -231,14 +252,23 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
 
 
 def load_rows(path: str) -> List[dict]:
-    """Either a JSON array or JSONL — both artifact shapes exist."""
+    """A JSON array, JSONL, or a report dict carrying a ``rows`` table
+    (``ANALYSIS.json``) — all three artifact shapes exist."""
     with open(path) as f:
         text = f.read().strip()
     if not text:
         return []
     if text[0] == "[":
         return json.loads(text)
-    return [json.loads(line) for line in text.splitlines() if line.strip()]
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # multi-record JSONL: one dict per line
+        return [json.loads(line) for line in text.splitlines()
+                if line.strip()]
+    if isinstance(doc, dict) and "rows" in doc:
+        return doc["rows"]
+    return [doc]
 
 
 def _row_key(row: dict, fields: Tuple[str, ...]) -> Tuple:

@@ -1,587 +1,49 @@
 #!/usr/bin/env python
-"""Static check: no blocking device→host syncs in the serving hot path.
+"""Compatibility shim over ``elephas_tpu.analysis.legacy``.
 
-The pipelined scheduler's whole value is that the device never waits on
-Python — decode step N+1 is dispatched before step N's tokens are read,
-and the ONLY place a device value may cross to the host is
-``elephas_tpu/serving/host_sync.py``. A single stray ``int(device_val)``
-anywhere else silently serializes every step and erases the overlap, so
-this lint walks the serving package's ASTs and rejects every
-host-conversion call outside the sanctioned module:
-
-- ``int(...)`` / ``float(...)``        (implicit blocking scalar fetch)
-- ``.item()`` / ``.tolist()``          (explicit blocking conversions)
-- ``np.asarray(...)`` / ``np.array(...)`` (numpy coercion of a possibly
-  device array — host upload belongs to ``jnp.asarray``)
-- ``jax.device_get(...)``              (the raw transfer primitive)
-- ``.block_until_ready()`` / ``jax.block_until_ready(...)``
-
-A second rule guards the serving package's CLOCK DOMAIN: scheduler,
-metrics, and tracer all take an injectable ``clock=`` (tests drive them
-with fakes; spans are recorded retroactively with scheduler timestamps),
-so a raw ``time.time()`` / ``time.perf_counter()`` /
-``time.monotonic()`` call in serving code silently mixes wall domains —
-timestamps stop comparing against the injected clock's. Such calls are
-flagged; read the time through ``self.clock()`` instead. (Bare
-``time.monotonic`` as a default-argument VALUE is fine — only calls are
-flagged.)
-
-Escape hatch: a line whose source carries a ``# host-ok`` pragma is
-exempt — for conversions of values that PROVABLY never touched the
-device (caller-supplied python ints, numpy buffers already fetched
-through ``host_sync``), or host-only timing genuinely outside the
-scheduled path. The pragma keeps every exemption greppable.
-
-A third rule guards the PARAMETER-SERVER WIRE PATH: the packed codec
-(``elephas_tpu/parameter/wire.py``) replaced per-request pickling on
-the PS hot path, and ``wire.encode_pickle``/``wire.decode_pickle`` are
-the only sanctioned legacy-interop entry points. A direct
-``pickle.dumps(...)`` / ``pickle.loads(...)`` (or ``dump``/``load``)
-anywhere else in ``elephas_tpu/parameter/`` silently reintroduces the
-full-copy serialization the codec exists to remove — and worse, a
-``loads`` added before the HMAC check would reopen the
-verify-before-decode hole. Flagged outside ``wire.py``; the escape
-pragma is ``# pickle-ok``.
-
-A fourth rule guards the RESILIENCE CLOCK DOMAIN
-(``elephas_tpu/resilience/``): failure detection, MTTR measurement, and
-fault injection are all specified against injectable ``clock=`` /
-``sleep=`` hooks so chaos tests replay deterministically on fake time
-with zero real waiting. A raw ``time.time()`` / ``time.monotonic()`` /
-``time.perf_counter()`` — or, new in this domain, a raw ``time.sleep()``
-— hard-wires wall time into a code path tests need to drive, so all four
-are flagged anywhere in the resilience package. ``time.monotonic`` /
-``time.sleep`` as default-argument VALUES are fine (that IS the
-injection pattern); only calls are flagged. Escape pragma:
-``# clock-ok``, for timing provably outside any detector/injector path.
-
-A fifth rule enforces METRIC NAMING across the whole package: the
-registry grew Prometheus label support, so dimensions belong in
-``labelnames=``, never baked into the metric name — and Prometheus
-conventions make the unit part of the name. Any ``.counter("name")``
-call whose literal name doesn't end in ``_total``, any
-``.histogram("name")`` not ending in ``_seconds``, and any f-string
-name on either (an f-string IS a baked dimension — ``retrace_total::
-{program}`` was exactly the shape the label migration removed) is
-flagged. Names that arrive through a variable are not judged — the
-literal lives at its definition site, which is linted there. Gauges
-are unconstrained (no unit convention fits them all). Escape pragma:
-``# metric-ok``, for deliberate deviations (e.g. a bridge exporting a
-foreign system's names verbatim).
-
-A sixth rule closes the ANOMALY/ALERT VOCABULARY: FlightRecorder event
-kinds and SLO alert rule names are what dashboards, runbooks, and the
-alert engine's rule pack key on, so both come from registered-constant
-tables — ``obs.flight.KINDS`` and ``obs.alerts.RULE_NAMES``. A string
-literal passed positionally to ``.note("…")`` (the span ``note`` takes
-kwargs only, so a positional string is uniquely the flight recorder's)
-or as ``AlertRule("…")``'s name / ``kind=`` that isn't in its table is
-flagged, as is any f-string there. The vocabularies are read from the
-defining modules' ASTs — the lint never imports the package. Grow the
-table to add a kind; ``# kind-ok`` escapes deliberate test-local vocab.
-This rule also scans ``scripts/``.
-
-A seventh rule closes the OPS ROUTE VOCABULARY: every path the
-``OpsServer`` serves is registered through ``add_route("/…")`` against
-the ``obs.opsd.ROUTES`` constant — the table ``/meta`` advertises, 404
-bodies list, and the fleet aggregator polls. A route string at an
-``add_route``/``_add_route`` call site that isn't in ``ROUTES`` (or any
-f-string path) means the served surface and the documented surface have
-drifted, so it's flagged; grow ``ROUTES`` to add a route. The
-vocabulary is AST-read from ``opsd.py`` like the kind tables. Escape
-pragma: ``# route-ok``, for test-local throwaway routes. This rule also
-scans ``scripts/``.
-
-An eighth rule guards the paged pool's DONATION BOUNDARY: the
-``PagedKVPool`` cache pytree is donated to every compiled program that
-rewrites it (chunk prefill, paged decode, copy-on-write block copies),
-and the ONLY safe access path is the pool's guarded ``cache`` property
-plus ``swap()`` to reinstall — both live in ``serving/kv_pool.py``. An
-attribute read of ``._cache`` / ``._pad`` anywhere else in the serving
-package reaches past the ``DonatedBufferError`` guard and can hand out
-deleted buffers that surface as opaque XLA errors far from the bug.
-Flagged outside ``kv_pool.py``; escape pragma ``# pool-ok``, for code
-that provably holds a never-donated tree.
-
-Wired into tier-1 via ``tests/test_lint_blocking.py``; also runnable
-standalone: ``python scripts/lint_blocking.py`` (exit 1 on violations).
+The eight lint domains that grew here (host-sync, serving-clock,
+ps-pickle, resilience-clock, metric-naming, kind-vocab, route-vocab,
+pool-boundary) now live in the analysis subsystem, where they share the
+AST walker, pragma machinery, and rule registry with the concurrency
+analyzers — run ``python -m elephas_tpu.analysis`` for the full driver
+(``--list-rules`` for the inventory). This module re-exports the
+historical functional API unchanged so existing imports and the tier-1
+suite (``tests/test_lint_blocking.py``) keep working; running it as a
+script behaves exactly as before.
 """
 
-from __future__ import annotations
-
-import ast
-import sys
-from pathlib import Path
-from typing import List, NamedTuple, Tuple
-
-PRAGMA = "host-ok"
-SANCTIONED = "host_sync.py"
-PICKLE_PRAGMA = "pickle-ok"
-PICKLE_SANCTIONED = "wire.py"
-CLOCK_PRAGMA = "clock-ok"
-METRIC_PRAGMA = "metric-ok"
-KIND_PRAGMA = "kind-ok"
-ROUTE_PRAGMA = "route-ok"
-POOL_PRAGMA = "pool-ok"
-POOL_SANCTIONED = "kv_pool.py"
-_POOL_PRIVATE = ("_cache", "_pad")
-_NUMPY_NAMES = ("np", "numpy")
-_CLOCK_ATTRS = ("time", "perf_counter", "monotonic")
-_PICKLE_ATTRS = ("dumps", "loads", "dump", "load")
-_METRIC_SUFFIX = {"counter": "_total", "histogram": "_seconds"}
-
-
-class Violation(NamedTuple):
-    path: str
-    lineno: int
-    call: str
-    line: str
-    domain: str = "serving"
-
-    def __str__(self):
-        if self.domain == "route":
-            return (
-                f"{self.path}:{self.lineno}: unregistered route "
-                f"{self.call} — opsd routes come from obs.opsd.ROUTES "
-                f"(grow the table so /meta, 404 bodies, and the fleet "
-                f"poller stay in sync; `# {ROUTE_PRAGMA}` for test-local "
-                f"throwaway routes)\n    {self.line.strip()}"
-            )
-        if self.domain == "kind":
-            return (
-                f"{self.path}:{self.lineno}: unregistered {self.call} — "
-                f"FlightRecorder kinds come from obs.flight.KINDS and "
-                f"alert rule names from obs.alerts.RULE_NAMES (grow the "
-                f"table, never invent the string inline; `# {KIND_PRAGMA}` "
-                f"for deliberate local vocab)\n    {self.line.strip()}"
-            )
-        if self.domain == "metric":
-            return (
-                f"{self.path}:{self.lineno}: metric name {self.call} "
-                f"violates naming (counters end `_total`, histograms end "
-                f"`_seconds`; an f-string name bakes a dimension into it — "
-                f"use labelnames=; `# {METRIC_PRAGMA}` for deliberate "
-                f"foreign names)\n    {self.line.strip()}"
-            )
-        if self.domain == "pool":
-            return (
-                f"{self.path}:{self.lineno}: donated-pool internal "
-                f"{self.call} read outside kv_pool.py — donated buffers "
-                f"must go through the guarded `pool.cache`/`pool.pad` "
-                f"properties and `pool.swap()` (a raw `._cache` read can "
-                f"hand out deleted buffers; `# {POOL_PRAGMA}` only for a "
-                f"tree provably never donated)\n    {self.line.strip()}"
-            )
-        if self.domain == "resilience":
-            what = "raw sleep" if self.call == "time.sleep" \
-                else "raw clock call"
-            return (
-                f"{self.path}:{self.lineno}: {what} `{self.call}` in "
-                f"resilience code bypasses the injected clock/sleep hooks "
-                f"(thread a `clock=`/`sleep=` parameter so chaos tests run "
-                f"on fake time; `# {CLOCK_PRAGMA}` only for timing outside "
-                f"every detector/injector path)\n    {self.line.strip()}"
-            )
-        if self.call.startswith("pickle."):
-            return (
-                f"{self.path}:{self.lineno}: direct `{self.call}` outside "
-                f"wire.py reintroduces per-request pickling on the PS hot "
-                f"path (route through wire.encode_pickle/decode_pickle; "
-                f"`# {PICKLE_PRAGMA}` only for data that never crosses the "
-                f"wire)\n    {self.line.strip()}"
-            )
-        if self.call.startswith("time."):
-            return (
-                f"{self.path}:{self.lineno}: raw clock call `{self.call}` "
-                f"bypasses the injected serving clock (read `self.clock()`; "
-                f"`# {PRAGMA}` only for timing outside the scheduled path)"
-                f"\n    {self.line.strip()}"
-            )
-        return (
-            f"{self.path}:{self.lineno}: blocking host sync `{self.call}` "
-            f"outside host_sync.py (add `# {PRAGMA}` only if the value "
-            f"never touched the device)\n    {self.line.strip()}"
-        )
-
-
-def _call_name(node: ast.Call) -> str | None:
-    """The lint-relevant name of a call, or None if it's not watched."""
-    fn = node.func
-    if isinstance(fn, ast.Name) and fn.id in ("int", "float"):
-        return fn.id
-    if isinstance(fn, ast.Attribute):
-        if fn.attr in ("item", "tolist", "block_until_ready", "device_get"):
-            return f".{fn.attr}" if fn.attr != "device_get" else "device_get"
-        if fn.attr in ("asarray", "array") and isinstance(fn.value, ast.Name) \
-                and fn.value.id in _NUMPY_NAMES:
-            return f"{fn.value.id}.{fn.attr}"
-        if fn.attr in _CLOCK_ATTRS and isinstance(fn.value, ast.Name) \
-                and fn.value.id == "time":
-            return f"time.{fn.attr}"
-    return None
-
-
-def lint_file(path: Path) -> List[Violation]:
-    source = path.read_text()
-    lines = source.splitlines()
-    tree = ast.parse(source, filename=str(path))
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _call_name(node)
-        if name is None:
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if PRAGMA in line:
-            continue
-        out.append(Violation(str(path), node.lineno, name, line))
-    return out
-
-
-def lint_package(root: Path) -> List[Violation]:
-    """Lint every module in the serving package — recursively, so
-    subpackages (``serving/fleet/``) inherit the blocking-read and
-    clock-call bans — except the sanctioned sync point itself."""
-    out = []
-    for path in sorted(root.rglob("*.py")):
-        if path.name == SANCTIONED:
-            continue
-        out.extend(lint_file(path))
-    return out
-
-
-def _pickle_call_name(node: ast.Call) -> str | None:
-    """``pickle.dumps``-style attribute calls; bare ``loads(...)`` from a
-    ``from pickle import loads`` is caught too (module-qualified name is
-    synthesized so the message stays uniform)."""
-    fn = node.func
-    if isinstance(fn, ast.Attribute) and fn.attr in _PICKLE_ATTRS \
-            and isinstance(fn.value, ast.Name) \
-            and fn.value.id in ("pickle", "cPickle"):
-        return f"pickle.{fn.attr}"
-    return None
-
-
-def lint_pickle_file(path: Path) -> List[Violation]:
-    source = path.read_text()
-    lines = source.splitlines()
-    tree = ast.parse(source, filename=str(path))
-    imported = set()  # names bound by `from pickle import dumps as d`
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module == "pickle":
-            for alias in node.names:
-                if alias.name in _PICKLE_ATTRS:
-                    imported.add(alias.asname or alias.name)
-        if not isinstance(node, ast.Call):
-            continue
-        name = _pickle_call_name(node)
-        if name is None and isinstance(node.func, ast.Name) \
-                and node.func.id in imported:
-            name = f"pickle.{node.func.id}"
-        if name is None:
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if PICKLE_PRAGMA in line:
-            continue
-        out.append(Violation(str(path), node.lineno, name, line))
-    return out
-
-
-def lint_pickle_package(root: Path) -> List[Violation]:
-    """Lint every module in the parameter package except the sanctioned
-    codec home itself."""
-    out = []
-    for path in sorted(root.glob("*.py")):
-        if path.name == PICKLE_SANCTIONED:
-            continue
-        out.extend(lint_pickle_file(path))
-    return out
-
-
-def _resilience_call_name(node: ast.Call) -> str | None:
-    """``time.<clock>()`` AND ``time.sleep()`` — the resilience domain
-    bans both (everything there takes ``clock=``/``sleep=`` hooks)."""
-    fn = node.func
-    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
-            and fn.value.id == "time" \
-            and fn.attr in _CLOCK_ATTRS + ("sleep",):
-        return f"time.{fn.attr}"
-    return None
-
-
-def lint_resilience_file(path: Path) -> List[Violation]:
-    source = path.read_text()
-    lines = source.splitlines()
-    tree = ast.parse(source, filename=str(path))
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _resilience_call_name(node)
-        if name is None:
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if CLOCK_PRAGMA in line:
-            continue
-        out.append(Violation(str(path), node.lineno, name, line,
-                             domain="resilience"))
-    return out
-
-
-def lint_resilience_package(root: Path) -> List[Violation]:
-    """Lint every module in the resilience package — no sanctioned file:
-    real wall time enters ONLY through default-argument values."""
-    out = []
-    for path in sorted(root.glob("*.py")):
-        out.extend(lint_resilience_file(path))
-    return out
-
-
-def _metric_call_name(node: ast.Call) -> str | None:
-    """``<anything>.counter("…")`` / ``.histogram("…")`` with a judgeable
-    first argument: a string literal that breaks the suffix convention,
-    or any f-string (a baked dimension). Variable names pass — their
-    literal is linted where it's defined."""
-    fn = node.func
-    if not (isinstance(fn, ast.Attribute) and fn.attr in _METRIC_SUFFIX
-            and node.args):
-        return None
-    arg = node.args[0]
-    if isinstance(arg, ast.JoinedStr):
-        return f"<f-string> in .{fn.attr}()"
-    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
-            and not arg.value.endswith(_METRIC_SUFFIX[fn.attr]):
-        return f"`{arg.value}` in .{fn.attr}()"
-    return None
-
-
-def lint_metric_file(path: Path) -> List[Violation]:
-    source = path.read_text()
-    lines = source.splitlines()
-    tree = ast.parse(source, filename=str(path))
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _metric_call_name(node)
-        if name is None:
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if METRIC_PRAGMA in line:
-            continue
-        out.append(Violation(str(path), node.lineno, name, line,
-                             domain="metric"))
-    return out
-
-
-def lint_metric_package(root: Path) -> List[Violation]:
-    """Lint EVERY module of the package tree — metric names are a
-    process-global namespace, so no file is exempt."""
-    out = []
-    for path in sorted(root.rglob("*.py")):
-        out.extend(lint_metric_file(path))
-    return out
-
-
-def load_registered_vocab(pkg_root: Path):
-    """``(KINDS, RULE_NAMES)`` read straight from the defining modules'
-    ASTs — pure-literal tuples by construction, so ``literal_eval``
-    suffices and the lint never has to import the package (which would
-    drag in jax)."""
-    out = {}
-    for fname, const in (("flight.py", "KINDS"), ("alerts.py", "RULE_NAMES")):
-        tree = ast.parse((pkg_root / "obs" / fname).read_text())
-        for node in tree.body:
-            if isinstance(node, ast.Assign) and any(
-                    isinstance(t, ast.Name) and t.id == const
-                    for t in node.targets):
-                out[const] = tuple(ast.literal_eval(node.value))
-    return out["KINDS"], out["RULE_NAMES"]
-
-
-def _kind_call_names(node: ast.Call, kinds, rule_names) -> List[str]:
-    """Unregistered-vocabulary findings for one call. A positional
-    string to ``.note(…)`` is uniquely a FlightRecorder kind (span
-    ``note`` is kwargs-only); ``AlertRule(…)`` is judged on its name
-    (first positional) and ``kind=`` keyword. Strings that arrive
-    through variables pass — the literal is linted at its definition."""
-    fn = node.func
-    found = []
-
-    def judge(arg, vocab, where):
-        if isinstance(arg, ast.JoinedStr):
-            found.append(f"<f-string> {where}")
-        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
-                and arg.value not in vocab:
-            found.append(f"`{arg.value}` {where}")
-
-    if isinstance(fn, ast.Attribute) and fn.attr == "note" and node.args:
-        judge(node.args[0], kinds, "kind in .note()")
-    callee = fn.id if isinstance(fn, ast.Name) else (
-        fn.attr if isinstance(fn, ast.Attribute) else None)
-    if callee == "AlertRule":
-        if node.args:
-            judge(node.args[0], rule_names, "rule name in AlertRule()")
-        for kw in node.keywords:
-            if kw.arg == "kind":
-                judge(kw.value, kinds, "kind in AlertRule()")
-    return found
-
-
-def lint_kind_file(path: Path, kinds, rule_names) -> List[Violation]:
-    source = path.read_text()
-    lines = source.splitlines()
-    tree = ast.parse(source, filename=str(path))
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        names = _kind_call_names(node, kinds, rule_names)
-        if not names:
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if KIND_PRAGMA in line:
-            continue
-        for name in names:
-            out.append(Violation(str(path), node.lineno, name, line,
-                                 domain="kind"))
-    return out
-
-
-def lint_kind_package(pkg_root: Path,
-                      extra_roots: Tuple[Path, ...] = ()) -> List[Violation]:
-    """Lint the whole package tree plus any extra roots (``scripts/``) —
-    the vocabulary is process-global, so no file is exempt."""
-    kinds, rule_names = load_registered_vocab(pkg_root)
-    out = []
-    paths = sorted(pkg_root.rglob("*.py"))
-    for root in extra_roots:
-        paths.extend(sorted(root.glob("*.py")))
-    for path in paths:
-        out.extend(lint_kind_file(path, kinds, rule_names))
-    return out
-
-
-def load_route_vocab(pkg_root: Path) -> Tuple[str, ...]:
-    """``ROUTES`` read straight from ``obs/opsd.py``'s AST — a
-    pure-literal tuple by construction, so ``literal_eval`` suffices and
-    the lint never imports the package."""
-    tree = ast.parse((pkg_root / "obs" / "opsd.py").read_text())
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == "ROUTES"
-                for t in node.targets):
-            return tuple(ast.literal_eval(node.value))
-    raise RuntimeError("obs/opsd.py has no literal ROUTES table")
-
-
-def _route_call_names(node: ast.Call, routes) -> List[str]:
-    """Unregistered-route findings for one call: a string literal (or
-    f-string) as the first argument of ``add_route``/``_add_route``.
-    Paths through variables pass — linted at the literal's definition."""
-    fn = node.func
-    callee = fn.id if isinstance(fn, ast.Name) else (
-        fn.attr if isinstance(fn, ast.Attribute) else None)
-    if callee not in ("add_route", "_add_route") or not node.args:
-        return []
-    arg = node.args[0]
-    if isinstance(arg, ast.JoinedStr):
-        return [f"<f-string> in {callee}()"]
-    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
-            and arg.value not in routes:
-        return [f"`{arg.value}` in {callee}()"]
-    return []
-
-
-def lint_route_file(path: Path, routes) -> List[Violation]:
-    source = path.read_text()
-    lines = source.splitlines()
-    tree = ast.parse(source, filename=str(path))
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        names = _route_call_names(node, routes)
-        if not names:
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if ROUTE_PRAGMA in line:
-            continue
-        for name in names:
-            out.append(Violation(str(path), node.lineno, name, line,
-                                 domain="route"))
-    return out
-
-
-def lint_route_package(pkg_root: Path,
-                       extra_roots: Tuple[Path, ...] = ()) -> List[Violation]:
-    """Lint the whole package tree plus any extra roots (``scripts/``) —
-    the route table is what every fleet poller keys on, so no file is
-    exempt."""
-    routes = load_route_vocab(pkg_root)
-    out = []
-    paths = sorted(pkg_root.rglob("*.py"))
-    for root in extra_roots:
-        paths.extend(sorted(root.glob("*.py")))
-    for path in paths:
-        out.extend(lint_route_file(path, routes))
-    return out
-
-
-def lint_pool_file(path: Path) -> List[Violation]:
-    """Attribute READS of the pool's private donated leaves. Writes
-    (``x._cache = …``) are equally foreign outside the pool, so any
-    ``._cache`` / ``._pad`` attribute node is flagged regardless of
-    load/store context — the distinction isn't worth the subtlety."""
-    source = path.read_text()
-    lines = source.splitlines()
-    tree = ast.parse(source, filename=str(path))
-    out = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Attribute)
-                and node.attr in _POOL_PRIVATE):
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if POOL_PRAGMA in line:
-            continue
-        out.append(Violation(str(path), node.lineno, f"`.{node.attr}`",
-                             line, domain="pool"))
-    return out
-
-
-def lint_pool_package(root: Path) -> List[Violation]:
-    """Lint the serving package tree except the pool module itself —
-    the only file allowed to touch the donated leaves directly."""
-    out = []
-    for path in sorted(root.rglob("*.py")):
-        if path.name == POOL_SANCTIONED:
-            continue
-        out.extend(lint_pool_file(path))
-    return out
-
-
-def main(argv: List[str] | None = None) -> List[Violation]:
-    args = list(sys.argv[1:] if argv is None else argv)
-    pkg_root = Path(__file__).resolve().parent.parent / "elephas_tpu"
-    root = Path(args[0]) if args else (pkg_root / "serving")
-    violations = lint_package(root)
-    if not args:
-        violations.extend(lint_pool_package(pkg_root / "serving"))
-        violations.extend(lint_pickle_package(pkg_root / "parameter"))
-        violations.extend(lint_resilience_package(pkg_root / "resilience"))
-        violations.extend(lint_metric_package(pkg_root))
-        violations.extend(lint_kind_package(
-            pkg_root, extra_roots=(Path(__file__).resolve().parent,)))
-        violations.extend(lint_route_package(
-            pkg_root, extra_roots=(Path(__file__).resolve().parent,)))
-    for v in violations:
-        print(v)
-    if not violations:
-        print(f"lint_blocking: {root} clean")
-    return violations
-
+from elephas_tpu.analysis.legacy import (  # noqa: F401
+    CLOCK_PRAGMA,
+    KIND_PRAGMA,
+    METRIC_PRAGMA,
+    PICKLE_PRAGMA,
+    PICKLE_SANCTIONED,
+    POOL_PRAGMA,
+    POOL_SANCTIONED,
+    PRAGMA,
+    ROUTE_PRAGMA,
+    SANCTIONED,
+    Violation,
+    lint_file,
+    lint_kind_file,
+    lint_kind_package,
+    lint_metric_file,
+    lint_metric_package,
+    lint_package,
+    lint_pickle_file,
+    lint_pickle_package,
+    lint_pool_file,
+    lint_pool_package,
+    lint_resilience_file,
+    lint_resilience_package,
+    lint_route_file,
+    lint_route_package,
+    load_registered_vocab,
+    load_route_vocab,
+    main,
+)
 
 if __name__ == "__main__":
+    import sys
+
     sys.exit(1 if main() else 0)

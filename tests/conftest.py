@@ -48,3 +48,42 @@ def make_blobs(n=512, num_classes=4, dim=20, seed=0, one_hot=True, spread=3.0):
 @pytest.fixture()
 def blobs():
     return make_blobs()
+
+
+# -- runtime lock sanitizer ---------------------------------------------------
+
+#: Concurrency suites run with the lock sanitizer ON: every
+#: ``make_lock``-routed lock (buffer version guard, RWLock, telemetry
+#: store, flight recorder, alert engine, request queue, fleet
+#: router/replica, snapshot-encode cache) order-checks each acquisition
+#: against the statically derived graph (ANALYSIS.json) plus every
+#: order observed in-process, and RAISES on inversion instead of
+#: deadlocking CI. Other suites keep the zero-overhead plain-lock path.
+_SANITIZED_SUITES = {
+    "test_hogwild_races",
+    "test_rwlock",
+    "test_opsd",
+    "test_fleet",
+    "test_fleet_serving",
+    "test_locksan",
+}
+
+
+@pytest.fixture(autouse=True)
+def _lock_sanitizer(request):
+    mod = getattr(request.node, "module", None)
+    name = (mod.__name__ if mod is not None else "").rsplit(".", 1)[-1]
+    if name not in _SANITIZED_SUITES or name == "test_locksan":
+        # test_locksan drives enable()/disable() itself
+        yield
+        return
+    from pathlib import Path
+
+    from elephas_tpu.utils import locksan
+
+    analysis = Path(__file__).resolve().parent.parent / "ANALYSIS.json"
+    locksan.enable(analysis_path=analysis if analysis.exists() else None)
+    try:
+        yield
+    finally:
+        locksan.disable()

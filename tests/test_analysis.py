@@ -1,0 +1,407 @@
+"""Analysis-subsystem self-tests.
+
+Two layers: (1) the repo-clean invariant — every registered rule runs
+over THIS repo with zero unsuppressed violations, and the committed
+``ANALYSIS.json`` matches a fresh report through the bench_gate rules
+(so the artifact can't silently rot); (2) seeded synthetic repos pinned
+as MUST-FIRE — a known lock-order cycle, a known fsync-under-lock, a
+known interprocedural socket-send — proving the analyzers cannot
+silently lose their teeth. The dead-pragma rule is exercised both ways:
+a live ``# lock-ok`` is not flagged, a stale one is, and a pragma
+mentioned inside a doc comment is invisible.
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from elephas_tpu.analysis import (build_report, build_rules, run_rules,
+                                  suppressions, violations)
+from elephas_tpu.analysis.cli import main as analysis_main
+from elephas_tpu.analysis.core import Repo
+from elephas_tpu.analysis.locks import get_analysis
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def synth(tmp_path: Path, files: dict) -> Repo:
+    """Materialize ``{relpath: source}`` under a synthetic package."""
+    for rel, src in files.items():
+        p = tmp_path / "elephas_tpu" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Repo(tmp_path)
+
+
+def run_rule(repo: Repo, name: str):
+    by_rule = run_rules(repo)
+    return by_rule[name]
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_registry_names_unique_and_complete():
+    rules = build_rules()
+    names = [r.name for r in rules]
+    assert len(names) == len(set(names))
+    for expected in ("host-sync", "serving-clock", "ps-pickle",
+                     "resilience-clock", "metric-naming", "kind-vocab",
+                     "route-vocab", "pool-boundary", "lock-order",
+                     "lock-blocking", "dead-pragma"):
+        assert expected in names
+    # dead-pragma audits the others, so it must come last
+    assert names[-1] == "dead-pragma"
+
+
+def test_list_rules_cli(capsys):
+    assert analysis_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "lock-order" in out and "# lock-ok" in out
+    assert "dead-pragma" in out
+
+
+# -- repo-clean invariant ----------------------------------------------------
+
+
+def test_repo_is_clean():
+    report = build_report(REPO_ROOT)
+    assert report["violations"] == [], report["violations"]
+    total = report["rows"][-1]
+    assert total["lock_cycles"] == 0
+    # the graph is not degenerate: the analyzers actually see the code
+    assert total["locks"] > 20
+    assert total["lock_edges"] >= 5
+    assert total["suppressions"] > 0
+
+
+def test_committed_analysis_json_is_fresh():
+    """ANALYSIS.json is a gated artifact: a stale commit fails here the
+    same way it fails ``bench_gate.py --analysis``."""
+    committed = json.loads((REPO_ROOT / "ANALYSIS.json").read_text())
+    fresh = build_report(REPO_ROOT)
+    import scripts.bench_gate as bg
+
+    checks = bg.compare(committed["rows"], fresh["rows"], "analysis")
+    bad = [c for c in checks if not c["ok"]]
+    assert not bad, bad
+
+
+def test_known_order_edges_present():
+    """The PR-4 apply-site ordering is IN the derived graph: the buffer
+    write lock is taken before the version guard, never after."""
+    la = get_analysis(Repo(REPO_ROOT))
+    edges = {(e.src, e.dst) for e in la.edges()}
+    assert ("ParameterBuffer._lock", "ParameterBuffer._version_guard") \
+        in edges
+    assert ("ParameterBuffer._version_guard", "ParameterBuffer._lock") \
+        not in edges
+
+
+# -- synthetic must-fire: lock-order cycle -----------------------------------
+
+
+CYCLE_FILES = {
+    "alpha.py": """
+        import threading
+
+        from elephas_tpu.beta import B
+
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.b = B()
+
+            def one(self):
+                with self._lock:
+                    self.b.poke()
+    """,
+    "beta.py": """
+        import threading
+
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.a = None
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+            def two(self):
+                with self._lock:
+                    self.a.one()
+    """,
+}
+
+
+def test_lock_cycle_must_fire(tmp_path):
+    repo = synth(tmp_path, CYCLE_FILES)
+    found = violations(run_rule(repo, "lock-order"))
+    assert found, "seeded lock cycle did not fire"
+    msg = found[0].message
+    assert "A._lock" in msg and "B._lock" in msg
+    assert found[0].chain, "cycle finding must carry a witness path"
+    assert any("alpha.py" in step for step in found[0].chain)
+
+
+def test_lock_cycle_cli_exits_nonzero(tmp_path):
+    synth(tmp_path, CYCLE_FILES)
+    assert analysis_main(["--root", str(tmp_path)]) == 1
+
+
+def test_lock_ok_pragma_breaks_the_cycle(tmp_path):
+    files = dict(CYCLE_FILES)
+    files["beta.py"] = files["beta.py"].replace(
+        "self.a.one()", "self.a.one()  # lock-ok: callback, lock released")
+    repo = synth(tmp_path, files)
+    found = run_rule(repo, "lock-order")
+    assert violations(found) == []
+    assert suppressions(found), "pragma'd edge must be ledgered"
+
+
+def test_self_deadlock_cycle(tmp_path):
+    repo = synth(tmp_path, {"gamma.py": """
+        import threading
+
+
+        class G:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """})
+    found = violations(run_rule(repo, "lock-order"))
+    assert found
+    assert "re-acquired" in found[0].message
+
+
+def test_nonblocking_acquire_adds_no_edge(tmp_path):
+    repo = synth(tmp_path, {"delta.py": """
+        import threading
+
+
+        class D:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def probe(self):
+                with self._a:
+                    got = self._b.acquire(blocking=False)
+                    if got:
+                        self._b.release()
+    """})
+    la = get_analysis(repo)
+    assert ("D._a", "D._b") not in {(e.src, e.dst) for e in la.edges()}
+    assert violations(run_rule(repo, "lock-order")) == []
+
+
+def test_make_lock_name_drift_fires(tmp_path):
+    repo = synth(tmp_path, {"epsilon.py": """
+        from elephas_tpu.utils.locksan import make_lock
+
+
+        class E:
+            def __init__(self):
+                self._lock = make_lock("Wrong.name")
+    """})
+    found = violations(run_rule(repo, "lock-order"))
+    assert found
+    assert "E._lock" in found[0].message
+
+
+# -- synthetic must-fire: blocking under a lock ------------------------------
+
+
+def test_fsync_under_lock_must_fire(tmp_path):
+    repo = synth(tmp_path, {"zeta.py": """
+        import os
+        import threading
+        import time
+
+
+        class Z:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._fh = None
+
+            def save(self):
+                with self._lock:
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+
+            def nap(self):
+                with self._lock:
+                    time.sleep(0.5)
+    """})
+    found = violations(run_rule(repo, "lock-blocking"))
+    idents = {f.ident for f in found}
+    assert ".flush" in idents
+    assert "os.fsync" in idents
+    assert "time.sleep" in idents
+    assert all("Z._lock" in f.message for f in found)
+
+
+def test_interprocedural_send_under_lock(tmp_path):
+    repo = synth(tmp_path, {"eta.py": """
+        import threading
+
+
+        class H:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._sock = None
+
+            def _io(self):
+                self._sock.sendall(b"x")
+
+            def locked_io(self):
+                with self._lock:
+                    self._io()
+    """})
+    found = violations(run_rule(repo, "lock-blocking"))
+    assert found, "call-under-lock to a blocking body did not fire"
+    assert found[0].chain, "interprocedural finding must carry the chain"
+    assert "H._io" in found[0].message
+
+
+def test_pragma_on_blocking_site_stops_propagation(tmp_path):
+    repo = synth(tmp_path, {"theta.py": """
+        import threading
+
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._sock = None
+
+            def _io(self):
+                self._sock.sendall(b"x")  # lock-ok: lock exists for this
+
+            def locked_io(self):
+                with self._lock:
+                    self._io()
+    """})
+    found = run_rule(repo, "lock-blocking")
+    assert violations(found) == []
+    assert suppressions(found), "sanctioned site must be ledgered"
+
+
+def test_condition_wait_on_own_lock_is_fine(tmp_path):
+    repo = synth(tmp_path, {"iota.py": """
+        import threading
+
+
+        class W:
+            def __init__(self):
+                self._cond = threading.Condition(threading.Lock())
+
+            def wait_for_it(self):
+                with self._cond:
+                    self._cond.wait()
+    """})
+    assert violations(run_rule(repo, "lock-blocking")) == []
+
+
+# -- dead-pragma audit -------------------------------------------------------
+
+
+def test_dead_lock_ok_pragma_fires(tmp_path):
+    repo = synth(tmp_path, {"kappa.py": """
+        import threading
+
+
+        class K:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fine(self):
+                with self._lock:
+                    x = 1  # lock-ok: nothing blocking here anymore
+                    return x
+    """})
+    found = violations(run_rule(repo, "dead-pragma"))
+    assert found
+    assert found[0].ident == "lock-ok"
+
+
+def test_live_pragma_not_flagged(tmp_path):
+    repo = synth(tmp_path, {"lam.py": """
+        import os
+        import threading
+
+
+        class L:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._fh = None
+
+            def save(self):
+                with self._lock:
+                    os.fsync(self._fh.fileno())  # lock-ok: durability
+    """})
+    by_rule = run_rules(repo)
+    assert violations(by_rule["dead-pragma"]) == []
+    assert suppressions(by_rule["lock-blocking"])
+
+
+def test_doc_mention_of_pragma_is_not_an_escape(tmp_path):
+    repo = synth(tmp_path, {"mu.py": """
+        import threading
+
+        #: table of things; grow it, don't inline (``# lock-ok`` escapes)
+        TABLE = ("a", "b")
+
+
+        class M:
+            def __init__(self):
+                self._lock = threading.Lock()
+    """})
+    assert violations(run_rule(repo, "dead-pragma")) == []
+
+
+def test_pragma_outside_rule_scope_not_audited(tmp_path):
+    # host-ok is only honored in serving/ — elsewhere it's commentary
+    repo = synth(tmp_path, {"nu.py": """
+        X = 1  # host-ok
+    """})
+    assert violations(run_rule(repo, "dead-pragma")) == []
+
+
+# -- report / JSON shape -----------------------------------------------------
+
+
+def test_report_json_shape(tmp_path):
+    synth(tmp_path, CYCLE_FILES)
+    report = build_report(tmp_path)
+    assert {"root", "rules", "rows", "violations", "suppressions",
+            "lock_graph"} <= set(report)
+    assert report["rows"][-1]["section"] == "total"
+    v = report["violations"][0]
+    assert {"rule", "path", "lineno", "ident", "message",
+            "suppressed"} <= set(v)
+    locks = {d["key"] for d in report["lock_graph"]["locks"]}
+    assert "A._lock" in locks and "B._lock" in locks
+    # the report round-trips through json
+    json.loads(json.dumps(report))
+
+
+def test_write_artifact(tmp_path, capsys):
+    synth(tmp_path, {"ok.py": "X = 1\n"})
+    out = tmp_path / "out.json"
+    rc = analysis_main(["--root", str(tmp_path), "--write", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["rows"][-1]["violations"] == 0
+    assert "clean" in capsys.readouterr().out

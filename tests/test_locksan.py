@@ -1,0 +1,279 @@
+"""Runtime lock-sanitizer unit tests.
+
+Pins the two contracts ISSUE 15 cares about: (1) the DISABLED path is
+zero-overhead — the factories hand back plain ``threading`` primitives,
+checked by type, so production never pays for the instrumentation; (2)
+the ENABLED path detects order inversions — including transitive ones
+and ones seeded from the statically derived ``ANALYSIS.json`` order —
+and raises at the acquisition site instead of deadlocking the process.
+Threads here are real: the cross-thread tests establish an order on one
+thread and violate it from another.
+"""
+
+import json
+import threading
+
+import pytest
+
+from elephas_tpu.utils import locksan
+from elephas_tpu.utils.locksan import (InstrumentedCondition,
+                                       InstrumentedLock, LockOrderInversion,
+                                       make_condition, make_lock, make_rlock)
+from elephas_tpu.utils.rwlock import RWLock
+
+
+@pytest.fixture
+def sanitizer():
+    locksan.enable()
+    yield locksan.registry()
+    locksan.disable()
+
+
+def run_in_thread(fn):
+    """Run ``fn`` on a fresh thread; re-raise anything it raised."""
+    box = {}
+
+    def worker():
+        try:
+            fn()
+        except BaseException as exc:  # noqa: BLE001 - relayed to caller
+            box["exc"] = exc
+
+    t = threading.Thread(target=worker, name="locksan-test")
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "worker thread hung"
+    if "exc" in box:
+        raise box["exc"]
+
+
+# -- disabled path: zero overhead --------------------------------------------
+
+
+def test_disabled_factories_return_plain_primitives():
+    assert not locksan.enabled()
+    assert type(make_lock("x")) is type(threading.Lock())
+    assert type(make_rlock("x")) is type(threading.RLock())
+    assert type(make_condition("x")) is threading.Condition
+    # and the module-level blocking hook is a free no-op
+    locksan.note_blocking("fsync")
+    assert locksan.registry().blocking_events == []
+
+
+def test_enable_swaps_factories_and_resets_registry(sanitizer):
+    assert locksan.enabled()
+    assert isinstance(make_lock("x"), InstrumentedLock)
+    assert isinstance(make_rlock("x"), InstrumentedLock)
+    assert isinstance(make_condition("x"), InstrumentedCondition)
+    sanitizer.load_static_order([("p", "q")])
+    locksan.enable()  # fresh registry: previous orders must not leak
+    assert locksan.registry() is not sanitizer
+    assert locksan.registry().snapshot_edges() == {}
+    assert locksan.registry()._static == {}
+
+
+# -- inversion detection -----------------------------------------------------
+
+
+def test_same_thread_inversion_raises(sanitizer):
+    a, b = make_lock("a"), make_lock("b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(LockOrderInversion, match="a -> b"):
+            a.acquire()
+    assert sanitizer.checks >= 3
+
+
+def test_cross_thread_inversion_raises(sanitizer):
+    a, b = make_lock("a"), make_lock("b")
+
+    def establish():
+        with a:
+            with b:
+                pass
+
+    def invert():
+        with b:
+            a.acquire()
+
+    run_in_thread(establish)
+    with pytest.raises(LockOrderInversion, match="inversion"):
+        run_in_thread(invert)
+
+
+def test_transitive_inversion_raises(sanitizer):
+    a, b, c = make_lock("a"), make_lock("b"), make_lock("c")
+    with a, b:
+        pass
+    with b, c:
+        pass
+    with c:
+        with pytest.raises(LockOrderInversion, match="a -> b -> c"):
+            a.acquire()
+
+
+def test_consistent_order_never_raises(sanitizer):
+    a, b = make_lock("a"), make_lock("b")
+
+    def ordered():
+        with a:
+            with b:
+                pass
+
+    for _ in range(3):
+        run_in_thread(ordered)
+    assert sanitizer.snapshot_edges() == {"a": {"b"}}
+
+
+def test_static_order_seeding(sanitizer):
+    """An inversion against the STATIC order fires on first execution —
+    no prior dynamic observation needed."""
+    sanitizer.load_static_order([("p", "q")])
+    p, q = make_lock("p"), make_lock("q")
+    with q:
+        with pytest.raises(LockOrderInversion):
+            p.acquire()
+
+
+def test_load_analysis_json(tmp_path):
+    art = tmp_path / "ANALYSIS.json"
+    art.write_text(json.dumps({
+        "lock_graph": {"edges": [{"src": "p", "dst": "q",
+                                  "path": "x.py", "lineno": 1}]}}))
+    locksan.enable(analysis_path=art)
+    try:
+        with make_lock("q"):
+            with pytest.raises(LockOrderInversion):
+                make_lock("p").acquire()
+    finally:
+        locksan.disable()
+
+
+def test_load_analysis_missing_file_is_tolerated(sanitizer):
+    assert sanitizer.load_analysis("/nonexistent/ANALYSIS.json") == 0
+
+
+def test_self_deadlock_raises(sanitizer):
+    lk = make_lock("solo")
+    lk.acquire()
+    with pytest.raises(LockOrderInversion, match="self-deadlock"):
+        lk.acquire()
+
+
+def test_rlock_reentry_allowed(sanitizer):
+    lk = make_rlock("re")
+    with lk:
+        with lk:
+            assert sanitizer.held() == ["re", "re"]
+    assert sanitizer.held() == []
+
+
+def test_nonblocking_acquire_is_exempt(sanitizer):
+    a, b = make_lock("a"), make_lock("b")
+    with a, b:
+        pass
+    with b:
+        assert a.acquire(blocking=False)  # would raise if order-checked
+        a.release()
+    # and it adds no edge that would poison later checks
+    assert "b" not in sanitizer.snapshot_edges()
+
+
+def test_timed_acquire_failure_leaves_clean_stack(sanitizer):
+    lk = make_lock("held-elsewhere")
+    grabbed = threading.Event()
+    done = threading.Event()
+
+    def holder():
+        with lk._inner:
+            grabbed.set()
+            done.wait(timeout=10)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    grabbed.wait(timeout=10)
+    assert lk.acquire(timeout=0.05) is False
+    assert sanitizer.held() == []
+    done.set()
+    t.join(timeout=10)
+
+
+# -- condition / blocking events ---------------------------------------------
+
+
+def test_condition_wait_notify_roundtrip(sanitizer):
+    cond = make_condition("C.cond")
+    ready = []
+
+    def consumer():
+        with cond:
+            while not ready:
+                cond.wait(timeout=10)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    with cond:
+        ready.append(1)
+        cond.notify()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    # own lock is excluded: waiting on your own cond is not a finding
+    assert sanitizer.blocking_events == []
+    assert sanitizer.held() == []
+
+
+def test_condition_wait_under_foreign_lock_is_recorded(sanitizer):
+    outer = make_lock("outer")
+    cond = make_condition("C.cond")
+    with outer:
+        with cond:
+            cond.wait(timeout=0.01)
+    held, desc, _thread = sanitizer.blocking_events[0]
+    assert held == ("outer",)
+    assert "C.cond" in desc
+
+
+def test_note_blocking_records_held_stack(sanitizer):
+    with make_lock("j"):
+        locksan.note_blocking("journal fsync")
+    locksan.note_blocking("idle fsync")  # nothing held: not an event
+    assert sanitizer.blocking_events == [
+        (("j",), "journal fsync", "MainThread")]
+
+
+# -- RWLock integration ------------------------------------------------------
+
+
+def test_rwlock_is_one_graph_node(sanitizer):
+    rw = RWLock(name="Buf._lock")
+    aux = make_lock("aux")
+    with rw.reading():
+        with aux:
+            pass
+    with aux:
+        with pytest.raises(LockOrderInversion):
+            rw.acquire_write()
+
+
+def test_rwlock_nested_reads_are_reentrant(sanitizer):
+    rw = RWLock(name="Buf._lock")
+    with rw.reading():
+        with rw.reading():
+            pass
+    assert sanitizer.held() == []
+
+
+def test_rwlock_write_after_read_same_thread_raises(sanitizer):
+    rw = RWLock(name="Buf._lock")
+    rw.acquire_read()
+    with pytest.raises(LockOrderInversion, match="self-deadlock"):
+        rw.acquire_write()
+    rw.release()
+
+
+def test_unnamed_rwlock_is_untracked(sanitizer):
+    rw = RWLock()
+    with rw.writing():
+        assert sanitizer.held() == []
