@@ -49,6 +49,7 @@ RULE_NAMES = (
     "goodput_burn_critical",
     "canary_probe_failures",
     "staleness_rejection_rate",
+    "tune_trial_stalled",
 )
 
 _PREDICATES = (">", "<")
@@ -158,6 +159,14 @@ def default_rules() -> List[AlertRule]:
         AlertRule("canary_probe_failures", "serving_canary_fail_total",
                   ">", 0.0, kind="canary_fail", mode="rate",
                   window_s=60.0, severity="error"),
+        # Elastic tuner: the slowest RUNNING trial has not progressed
+        # for two minutes. The gauge is refreshed at every unit
+        # boundary by the tune runner; a trial wedged in a device call
+        # can't refresh it down, which is exactly the point — the
+        # elastic pool's detector will expire the worker, and this rule
+        # is the operator-facing heads-up that a re-lease is coming.
+        AlertRule("tune_trial_stalled", "tune_trial_stall_seconds",
+                  ">", 120.0, kind="trial_stalled", severity="warn"),
     ]
 
 

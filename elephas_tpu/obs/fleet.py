@@ -470,7 +470,8 @@ class FleetAggregator:
         # can mix newer engines with older procs (or fakes) that don't
         # serve them, and their absence must not fail the whole poll —
         # each is fetched in its own tolerant attempt.
-        for route in ("/load", "/slo", "/replicas", "/incidents"):
+        for route in ("/load", "/slo", "/replicas", "/incidents",
+                      "/trials"):
             try:
                 scrape[route[1:]] = json.loads(
                     self.fetch(f"{entry.url}{route}", self.timeout))
@@ -536,6 +537,12 @@ class FleetAggregator:
         per_incidents = {e.name: e.scrape["incidents"]
                          for e in entries
                          if e.scrape.get("incidents", {}).get("meta")}
+        # Tuner searches (/trials): only procs actually driving one
+        # contribute (a non-empty trial table) — the board shows the
+        # search through whichever process hosts the runner.
+        per_trials = {e.name: e.scrape["trials"]
+                      for e in entries
+                      if e.scrape.get("trials", {}).get("trials")}
         status_counts: Dict[str, int] = {}
         for e in entries:
             status_counts[e.status] = status_counts.get(e.status, 0) + 1
@@ -552,4 +559,5 @@ class FleetAggregator:
             "slo": per_slo,
             "replicas": per_replicas,
             "incidents": per_incidents,
+            "trials": per_trials,
         }

@@ -78,6 +78,14 @@ KINDS = (
     # durable telemetry store (obs/store.py): a torn segment tail was
     # truncated on warm reopen (predecessor boot died mid-append)
     "store_corrupt_tail",
+    # elastic hyperparameter tuner (tune/): successive-halving lifecycle
+    # — a trial promoted to the next rung, early-stopped by the halving
+    # rule, resumed from its vault checkpoint after a worker death, or
+    # flagged by the stall detector as running without progress
+    "trial_promoted",
+    "trial_pruned",
+    "trial_resumed",
+    "trial_stalled",
 )
 
 

@@ -92,6 +92,7 @@ ROUTES = (
     "/canary",
     "/replicas",
     "/incidents",
+    "/trials",
 )
 
 
@@ -148,6 +149,9 @@ class OpsServer:
         — durable-store disk stats + most recent journaled records,
         the live end of the post-mortem plane); empty store when
         unset.
+    trials_fn: the ``/trials`` payload (a ``TuneRunner.trials_snapshot``
+        — per-trial rung/status/loss cards, rung counts, the search
+        digest); empty search when unset.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
@@ -165,7 +169,8 @@ class OpsServer:
                  slo_fn: Optional[Callable[[], Dict]] = None,
                  canary_fn: Optional[Callable[[], Dict]] = None,
                  replicas_fn: Optional[Callable[[], Dict]] = None,
-                 incidents_fn: Optional[Callable[[], Dict]] = None):
+                 incidents_fn: Optional[Callable[[], Dict]] = None,
+                 trials_fn: Optional[Callable[[], Dict]] = None):
         self._requested_port = port
         self.host = host if host is not None else _default_bind_host()
         self._registry = registry
@@ -187,6 +192,7 @@ class OpsServer:
         self._canary_fn = canary_fn
         self._replicas_fn = replicas_fn
         self._incidents_fn = incidents_fn
+        self._trials_fn = trials_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_wall = None
@@ -212,6 +218,7 @@ class OpsServer:
         self._add_route("/canary", self._h_canary)
         self._add_route("/replicas", self._h_replicas)
         self._add_route("/incidents", self._h_incidents)
+        self._add_route("/trials", self._h_trials)
 
     def _add_route(self, path: str, handler: Callable) -> None:
         self._routes[path] = handler
@@ -370,6 +377,12 @@ class OpsServer:
         if self._incidents_fn is not None:
             return 200, self._incidents_fn()
         return 200, {"meta": None, "recent": []}
+
+    def _h_trials(self, query):
+        if self._trials_fn is not None:
+            return 200, self._trials_fn()
+        return 200, {"counts": {}, "trials": {}, "best": None,
+                     "search_digest": None, "epochs_spent": 0}
 
     def start(self) -> "OpsServer":
         if self._httpd is not None:

@@ -183,6 +183,43 @@ class UnitLedger:
                 else None
             return True, finished
 
+    def add_units(self, units: List[Unit]) -> int:
+        """Grow the ledger mid-drain (the tuner's rung promotions: a
+        promoted trial's next rung becomes schedulable work the moment
+        the promoting result lands). Appended to the BACK of the queue
+        — promotions are new work, not repair — and deduped against
+        pending/leased/done so an idempotent caller (a zombie replaying
+        a promotion decision) cannot double-schedule a unit. Epochs
+        beyond the constructed range extend the per-epoch table; such
+        late epochs never fire ``finished_epoch`` (their population is
+        dynamic, there is no "all partitions" to count against — the
+        scheduler owns completion semantics for dynamic work). Returns
+        how many units were actually added.
+
+        Safe-growth contract: callers must add units from INSIDE the
+        processing of a still-leased unit (or before ``start``), so
+        ``all_done`` can never report True while a grow is in flight.
+        """
+        added = 0
+        with self._lock:
+            pending = set(self._pending)
+            for unit in units:
+                unit = tuple(unit)
+                if unit in self._done or unit in self._leased \
+                        or unit in pending:
+                    continue
+                epoch = int(unit[0])
+                while epoch >= len(self._epoch_done):
+                    self._epoch_done.append(0)
+                    # keep units_per_epoch as the rung-0 population;
+                    # dynamic epochs opt out of epoch-complete firing
+                    # by construction (population unknown).
+                self.epochs = max(self.epochs, epoch + 1)
+                self._pending.append(unit)
+                pending.add(unit)
+                added += 1
+        return added
+
     def requeue_worker(self, worker_id: str) -> List[Unit]:
         """Return all of ``worker_id``'s leases to the FRONT of the
         queue (epoch-major order preserved); idempotent."""

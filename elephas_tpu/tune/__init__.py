@@ -1,0 +1,44 @@
+"""elephas_tpu.tune — elastic fleet-scale hyperparameter search.
+
+The reference's third pillar (``elephas/hyperparam.py``) rebuilt as a
+first-class subsystem on the machinery the rest of the package already
+ships: trials are lease-fenced ``UnitLedger`` units on the
+``ElasticWorkerPool`` (PR 8), rung checkpoints ride the packed wire
+codec onto the sharded PS group (PRs 4/11), promotion decisions come
+from an async successive-halving scheduler fed by the PR 7 health
+plane, and the whole search is observable end-to-end (counters, one
+search-root trace, the ``/trials`` opsd route, the ``fleet_top``
+TRIALS board).
+
+Layout:
+    trial.py      TrialSpec / TrialState + replay-stable digests
+    scheduler.py  AshaScheduler (async successive halving)
+    vault.py      MemoryVault / GroupVault rung checkpoints
+    runner.py     TuneRunner (the elastic-pool execution engine)
+    search.py     hp combinators, HyperParamModel (reference parity),
+                  sample_trials / run_search (the ASHA frontend)
+    cli.py        the ``elephas-tune`` console entry
+"""
+
+from elephas_tpu.tune.scheduler import AshaScheduler  # noqa: F401
+from elephas_tpu.tune.search import (  # noqa: F401
+    HyperParamModel,
+    current_trial_device,
+    hp,
+    run_search,
+    sample_space,
+    sample_trials,
+    width_bucket,
+)
+from elephas_tpu.tune.trial import TrialSpec, TrialState  # noqa: F401
+from elephas_tpu.tune.vault import (  # noqa: F401
+    GroupVault,
+    MemoryVault,
+    TrialCheckpoint,
+)
+
+__all__ = [
+    "AshaScheduler", "GroupVault", "HyperParamModel", "MemoryVault",
+    "TrialCheckpoint", "TrialSpec", "TrialState", "current_trial_device",
+    "hp", "run_search", "sample_space", "sample_trials", "width_bucket",
+]

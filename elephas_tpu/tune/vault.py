@@ -1,0 +1,173 @@
+"""Trial checkpoint vault: rung state that survives its worker.
+
+A promoted trial must RESUME — retraining rungs 0..r-1 after every
+promotion turns ASHA's geometric saving back into linear cost, and a
+worker kill mid-search must not reset its trials. The vault is the
+tuner's checkpoint plane: ``save(trial, rung, loss, state)`` after a
+rung completes, ``load(trial)`` before running one, and both round-trip
+the state through the **packed wire codec** (`parameter/wire.py`) so a
+checkpoint is exactly one PS frame — the same bytes a parameter push
+ships.
+
+Two backends:
+
+- ``MemoryVault`` — in-process, for tests and single-host searches.
+  States still encode/decode through the packed codec (shape/dtype
+  fidelity is asserted where it is cheap, not assumed).
+- ``GroupVault`` — checkpoints live ON the sharded PS group: the group
+  store is ``{t<i>: {"state": ..., "rung": -1, "loss": 0}}`` (built by
+  ``GroupVault.build_store``), a save pushes the *difference* against
+  the pulled snapshot as a normal additive delta (disjoint trials touch
+  disjoint leaves, so concurrent saves from different workers compose),
+  and a load pulls and reads the trial's subtree. A shard primary kill
+  mid-search is therefore survivable by the SAME machinery training
+  relies on: WAL-streamed standby promotion, boot fencing, directory
+  re-resolve — the tuner adds no new durability code.
+
+Zombie writes: lease fencing means at most one LIVE worker owns a
+trial, and the scheduler/ledger fence duplicate *accounting*; a zombie
+that re-saves a rung writes the deterministically identical state
+(seeded trials), so vault content is last-writer-wins over equal
+values. The rung leaf only ever grows for a live search.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from elephas_tpu.parameter import wire
+from elephas_tpu.utils import locksan
+
+__all__ = ["GroupVault", "MemoryVault", "TrialCheckpoint"]
+
+
+class TrialCheckpoint(NamedTuple):
+    state: Any          # the trial_fn's opaque numeric pytree
+    rung: int           # highest rung this state has completed
+    loss: float         # loss recorded at that rung
+
+
+def _tree_map2(fn, a, b):
+    if isinstance(a, dict):
+        return {k: _tree_map2(fn, a[k], b[k]) for k in a}
+    if isinstance(a, (list, tuple)):
+        return type(a)(_tree_map2(fn, x, y) for x, y in zip(a, b))
+    return fn(a, b)
+
+
+def _tree_map(fn, a):
+    if isinstance(a, dict):
+        return {k: _tree_map(fn, v) for k, v in a.items()}
+    if isinstance(a, (list, tuple)):
+        return type(a)(_tree_map(fn, v) for v in a)
+    return fn(a)
+
+
+def _copy_leaf(x):
+    return np.array(x)
+
+
+class MemoryVault:
+    """In-process vault; checkpoints are stored as packed wire frames
+    (encode on save, decode on load) so the codec path the GroupVault
+    rides is exercised even in unit tests."""
+
+    def __init__(self):
+        self._lock = locksan.make_lock("MemoryVault._lock")
+        self._frames: Dict[int, bytes] = {}
+        self._meta: Dict[int, Dict[str, float]] = {}
+
+    def save(self, trial_id: int, rung: int, loss: float, state) -> None:
+        buf = wire.encode_tree(state, version=int(rung)).tobytes()
+        with self._lock:
+            self._frames[int(trial_id)] = buf
+            self._meta[int(trial_id)] = {"rung": int(rung),
+                                         "loss": float(loss)}
+
+    def load(self, trial_id: int) -> Optional[TrialCheckpoint]:
+        with self._lock:
+            buf = self._frames.get(int(trial_id))
+            meta = self._meta.get(int(trial_id))
+        if buf is None or meta is None:
+            return None
+        decoded = wire.decode(buf)
+        # Decoded leaves are read-only views into ``buf`` — copy so the
+        # resumed trial may train in place.
+        state = _tree_map(_copy_leaf, decoded.tree)
+        return TrialCheckpoint(state, int(meta["rung"]), meta["loss"])
+
+
+class GroupVault:
+    """Checkpoints on a (sharded) parameter server.
+
+    ``client`` is any ``BaseParameterClient`` — typically a
+    ``ShardGroup().client()`` — over a store built by ``build_store``.
+    Trial state trees must be fixed-shape numeric pytrees (the same
+    contract parameters themselves obey).
+    """
+
+    #: Store key for trial ``i``.
+    @staticmethod
+    def key(trial_id: int) -> str:
+        return f"t{int(trial_id)}"
+
+    @classmethod
+    def build_store(cls, trial_ids: List[int], template) -> Dict[str, Any]:
+        """The PS store tree: one slot per trial, ``rung=-1`` marking
+        "no checkpoint yet" (deltas are additive, so the sentinel must
+        be part of the initial store, not a convention)."""
+        def zero(leaf):
+            return np.zeros_like(np.asarray(leaf, dtype=np.float64)
+                                 if np.asarray(leaf).dtype.kind not in "fiu"
+                                 else np.asarray(leaf))
+        return {
+            cls.key(t): {
+                "state": _tree_map(zero, template),
+                "rung": np.float64(-1.0),
+                "loss": np.float64(0.0),
+            }
+            for t in trial_ids
+        }
+
+    def __init__(self, client):
+        self._client = client
+        self._lock = locksan.make_lock("GroupVault._lock")
+
+    def _pull(self):
+        return self._client.get_parameters()
+
+    def save(self, trial_id: int, rung: int, loss: float, state) -> None:
+        key = self.key(trial_id)
+        with self._lock:
+            current = self._pull()
+            cur_slot = current[key]
+            delta = {
+                k: _tree_map(lambda leaf: np.zeros_like(np.asarray(leaf)),
+                             v)
+                for k, v in current.items()
+            }
+            delta[key] = {
+                "state": _tree_map2(
+                    lambda new, old: np.asarray(new, dtype=np.asarray(old).dtype)
+                    - np.asarray(old),
+                    state, cur_slot["state"]),
+                "rung": np.float64(float(rung) - float(np.asarray(cur_slot["rung"]))),
+                "loss": np.float64(float(loss) - float(np.asarray(cur_slot["loss"]))),
+            }
+            self._client.update_parameters(delta)
+
+    def load(self, trial_id: int) -> Optional[TrialCheckpoint]:
+        key = self.key(trial_id)
+        with self._lock:
+            current = self._pull()
+        slot = current.get(key)
+        if slot is None:
+            return None
+        rung = int(round(float(np.asarray(slot["rung"]))))
+        if rung < 0:
+            return None
+        state = _tree_map(_copy_leaf, slot["state"])
+        return TrialCheckpoint(state, rung,
+                               float(np.asarray(slot["loss"])))

@@ -183,6 +183,35 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             # regardless of what the committed baseline measured.
             ("store_overhead_pct", "limit", 2.0),
             ("store_overhead_within_2pct", "equal", 0.0),
+            # Tuner row (--tune): the chaos search (worker killed
+            # mid-rung + checkpoint-shard primary crashed mid-search)
+            # must reproduce the undisturbed reference EXACTLY — same
+            # winner digest, same search digest (winner trajectory +
+            # ladder), zero trials lost — because ASHA's promotion rule
+            # is order-invariant for the minimum-loss chain. The
+            # injected kill and the shard failover must actually have
+            # fired (a chaos arm that didn't hurt anything gates
+            # nothing), halving must have pruned most of the field, and
+            # the spent budget must do at least as well as the same
+            # budget given to full-ladder random trials. Absolute
+            # floors/equals throughout: none of these move with
+            # whatever a loaded CI machine measured last time.
+            ("tune_winner_stable", "equal", 0.0),
+            ("tune_search_digest_stable", "equal", 0.0),
+            ("tune_lost_trials", "equal", 0.0),
+            ("tune_ps_kill_fired", "equal", 0.0),
+            ("tune_final_pull_ok", "equal", 0.0),
+            ("tune_worker_deaths", "floor", 1.0),
+            ("tune_ps_failovers", "floor", 1.0),
+            ("tune_pruned_frac", "floor", 0.5),
+            ("tune_epochs_saved_frac", "floor", 0.5),
+            ("tune_loss_advantage", "floor", 0.0),
+            # The digests themselves are pinned exact (same style as
+            # staleness_digest): the trial set is seeded, so the winner
+            # identity and its rung-loss trajectory must replay
+            # bit-stably across machines, not just within one run.
+            ("winner_digest", "equal", 0.0),
+            ("search_digest", "equal", 0.0),
         ],
     ),
     "analysis": (

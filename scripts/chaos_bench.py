@@ -982,6 +982,125 @@ def scenario_staleness(seed: int = 11, steps: int = 60):
     }
 
 
+def scenario_tune(seed: int = 11, trials: int = 9, workers: int = 3):
+    """``--tune``: elastic ASHA search under a double chaos arm.
+
+    Three searches over the same seeded trial set:
+
+    - *reference* — undisturbed, in-memory vault. Its winner digest,
+      search digest, and epoch accounting are the anchors.
+    - *chaos* — checkpoints live on a K=2 socket ``ShardGroup`` through
+      a ``GroupVault``, pool worker ``w1`` is killed at its second
+      leased rung (``FaultPlan``), and shard 0's primary is crashed
+      mid-search (monitor promotes the WAL-streamed spare; the vault's
+      client rides the re-resolve path). The gate requires the chaos
+      arm to lose ZERO trials and reproduce the reference winner and
+      search digests exactly — ASHA's promotion rule is order-invariant
+      for the minimum-loss chain, so kills may reorder arrivals but
+      never change the winner.
+    - *random* — the classic baseline: the same epoch budget the ASHA
+      search actually spent, given to full-budget random trials from
+      the same sampler stream. ``tune_loss_advantage`` (random best −
+      ASHA best) must stay >= 0: halving never does worse than random
+      at equal cost, while training a fraction of the epochs.
+    """
+    from elephas_tpu.parameter.group import ShardGroup
+    from elephas_tpu.resilience import FaultInjector, FaultPlan
+    from elephas_tpu.tune import GroupVault, hp, sample_trials
+    from elephas_tpu.tune.cli import synthetic_trial_fn
+    from elephas_tpu.tune.search import run_search
+
+    eta, rungs, r0 = 3, 3, 1
+    space = {
+        "lr": hp.loguniform(np.log(1e-3), np.log(0.9)),
+        "width": hp.choice([32, 64, 128]),
+    }
+
+    def slow_trial_fn(config, state, epochs, trial_seed, rung):
+        # ~5 ms per epoch: rungs need nonzero wall time so leases
+        # spread across the pool and the planned worker kill lands
+        # mid-search instead of after one thread drained the queue.
+        time.sleep(0.005 * int(epochs))
+        return synthetic_trial_fn(config, state, epochs, trial_seed, rung)
+
+    base = run_search(slow_trial_fn, space, num_trials=trials, seed=seed,
+                      eta=eta, rungs=rungs, r0=r0, workers=workers)
+
+    # Chaos arm: same seeds, checkpoints on the shard group.
+    specs = sample_trials(space, trials, seed)
+    template = synthetic_trial_fn(specs[0].config, None, 1,
+                                  specs[0].seed, 0)["state"]
+    store = GroupVault.build_store([s.trial_id for s in specs], template)
+    plan = FaultPlan(seed=seed, kill_worker_at={"w1": 1})
+    with tempfile.TemporaryDirectory() as wal_root:
+        group = ShardGroup(store, 2, mode="socket", standby=1,
+                           wal_root=wal_root, suspect_after=0.3)
+        group.start()
+        group.start_monitor(interval=0.05)
+        ps_killed = threading.Event()
+
+        def kill_shard_later():
+            # Mid-search: late enough that checkpoints exist on the
+            # shard, early enough that rungs still run after failover.
+            time.sleep(0.25)
+            group.kill_primary(0)
+            ps_killed.set()
+
+        killer = threading.Thread(target=kill_shard_later, daemon=True)
+        try:
+            vault = GroupVault(group.client())
+            killer.start()
+            chaos = run_search(slow_trial_fn, space, num_trials=trials,
+                               seed=seed, eta=eta, rungs=rungs, r0=r0,
+                               workers=workers, vault=vault,
+                               injector=FaultInjector(plan))
+            killer.join(timeout=10.0)
+            # The promoted spare must serve the whole store again.
+            final_pull_ok = group.client().get_parameters() is not None
+        finally:
+            group.stop()
+
+    # Random baseline at the SAME spent budget: every random trial pays
+    # the full ladder, so the budget buys only a handful of configs.
+    full = eta ** (rungs - 1) * r0
+    n_random = max(1, int(base["epochs_spent"]) // full)
+    rand_specs = sample_trials(space, n_random, seed)
+    random_best = min(
+        synthetic_trial_fn(s.config, None, full, s.seed,
+                           rungs - 1)["loss"]
+        for s in rand_specs)
+
+    return {
+        "scenario": "tune",
+        "seed": seed,
+        "trials": trials,
+        "workers": workers,
+        "eta": eta,
+        "rungs": rungs,
+        "tune_epochs_spent": base["epochs_spent"],
+        "tune_full_budget_epochs": base["full_budget_epochs"],
+        "tune_epochs_saved_frac": round(
+            1.0 - base["epochs_spent"] / base["full_budget_epochs"], 4),
+        "tune_pruned_frac": round(base["pruned_frac"], 4),
+        "tune_best_loss": round(base["best_loss"], 6),
+        "random_best_loss": round(random_best, 6),
+        "random_epochs_spent": n_random * full,
+        "tune_loss_advantage": round(random_best - base["best_loss"], 6),
+        "tune_winner_stable": int(
+            chaos["winner_digest"] == base["winner_digest"]),
+        "tune_search_digest_stable": int(
+            chaos["search_digest"] == base["search_digest"]),
+        "tune_lost_trials": chaos["lost_trials"],
+        "tune_worker_deaths": chaos["pool"]["worker_deaths"],
+        "tune_requeued_units": chaos["pool"]["requeued_units"],
+        "tune_ps_failovers": len(group.promotions),
+        "tune_ps_kill_fired": int(ps_killed.is_set()),
+        "tune_final_pull_ok": int(final_pull_ok),
+        "winner_digest": base["winner_digest"],
+        "search_digest": base["search_digest"],
+    }
+
+
 def export_role_dumps(tracer, outdir, prefix="chaos_trace"):
     """Split the in-process span ring into the per-role dumps a real
     deployment would collect from each process's ``/trace`` route:
@@ -1047,6 +1166,13 @@ def main(argv=None):
                          "through a FleetAggregator polling the PS and "
                          "trainer ops endpoints (stale→dead→alive "
                          "transitions + measured scrape/merge cost)")
+    ap.add_argument("--tune", action="store_true",
+                    help="append the tuner row: elastic ASHA search with "
+                         "a worker killed mid-rung AND a checkpoint-"
+                         "shard primary crashed mid-search — winner and "
+                         "search digests must match the undisturbed "
+                         "reference, zero trials lost, and the spent "
+                         "budget must beat same-budget random search")
     args = ap.parse_args(argv)
 
     tracer = None
@@ -1073,6 +1199,8 @@ def main(argv=None):
         rows.append(scenario_staleness(seed=args.seed))
     if args.fleet:
         rows.append(scenario_fleet(x, y, args.epochs, args.outage))
+    if args.tune:
+        rows.append(scenario_tune(seed=args.seed))
 
     anchor = rows[1]["final_loss"]
     for row in rows[2:]:

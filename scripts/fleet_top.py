@@ -210,6 +210,36 @@ def render(snap: dict) -> str:
                      f"{'LOAD':>6} {'AFF HIT':>8} {'INFLT':>6} {'BURN':>6}")
         for rid, card in sorted((doc.get("replicas") or {}).items()):
             lines.append("  " + _replica_cells(rid, card, proc_status))
+    for proc, doc in sorted((snap.get("trials") or {}).items()):
+        proc_status = (snap["processes"].get(proc) or {}).get("status", "?")
+        counts = doc.get("counts") or {}
+        best = doc.get("best") or {}
+        digest = doc.get("search_digest")
+        lines.append("")
+        lines.append(
+            f"trials via {proc}: "
+            + "  ".join(f"{k}={counts.get(k, 0)}" for k in
+                        ("running", "paused", "promoted", "completed",
+                         "pruned"))
+            + f"  epochs={doc.get('epochs_spent', 0)}"
+            + (f"  digest={digest}" if digest else ""))
+        lines.append(f"  {'TRIAL':<7} {'STATUS':<10} {'RUNG':>4} "
+                     f"{'LOSS':>10} {'RESUMED':>7} {'DIGEST':<14}")
+        for tid, card in sorted((doc.get("trials") or {}).items(),
+                                key=lambda kv: int(kv[0])):
+            # A stale/dead runner's cards stopped updating — render the
+            # signal columns '-' like every other board.
+            alive = proc_status == "alive"
+            loss = card.get("loss")
+            mark = " *" if best and card.get("trial") == best.get("trial") \
+                else ""
+            lines.append(
+                f"  {str(card.get('trial', tid)):<7} "
+                f"{(str(card.get('status', '?')) if alive else '-'):<10} "
+                f"{(str(card.get('rung', '-')) if alive else '-'):>4} "
+                f"{(f'{loss:.5g}' if alive and loss is not None else '-'):>10} "
+                f"{(str(card.get('resumed', 0)) if alive else '-'):>7} "
+                f"{str(card.get('digest', '-')):<14}{mark}")
     workers = snap["workers"]
     if workers["workers"]:
         lines.append("")

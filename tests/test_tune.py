@@ -1,0 +1,384 @@
+"""Elastic ASHA tuner tests (tune/): pinned async-halving decisions on
+a seeded loss table with an injectable clock, straggler non-blocking,
+resume-from-rung after a mid-search worker death, sampler determinism,
+vault round-trips, and the ledger's mid-drain growth contract."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.obs import FlightRecorder, MetricsRegistry
+from elephas_tpu.resilience.elastic import UnitLedger
+from elephas_tpu.tune import (
+    AshaScheduler,
+    GroupVault,
+    MemoryVault,
+    TrialSpec,
+    hp,
+    run_search,
+    sample_trials,
+)
+from elephas_tpu.tune.runner import TuneRunner
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_sched(n=9, losses=None, **kw):
+    specs = [TrialSpec(i, {"tid": i}, seed=i) for i in range(n)]
+    kw.setdefault("eta", 3)
+    kw.setdefault("rungs", 3)
+    kw.setdefault("r0", 1)
+    kw.setdefault("clock", FakeClock())
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("flight", FlightRecorder(capacity=256))
+    return AshaScheduler(specs, **kw)
+
+
+def feed(sched, tid, rung, loss, delta_norm=None, worker="w0"):
+    sched.on_lease(tid, rung, worker)
+    return sched.on_result(tid, rung, loss, delta_norm)
+
+
+# -- rung geometry ----------------------------------------------------------
+
+
+def test_rung_geometry():
+    sched = make_sched()
+    assert [sched.cumulative_epochs(r) for r in range(3)] == [1, 3, 9]
+    assert [sched.rung_epochs(r) for r in range(3)] == [1, 2, 6]
+    assert sched.full_budget() == 9
+    assert sched.initial_units() == [(0, t) for t in range(9)]
+
+
+# -- pinned promotion / pruning decisions -----------------------------------
+
+
+def test_asha_pinned_promotions():
+    """Arrival-by-arrival halving decisions for a fixed loss table
+    (loss = tid / 10 at rung 0): the quota is floor(results/eta), ranked
+    results promote the moment the quota admits them, and already-
+    promoted trials never re-promote."""
+    sched = make_sched()
+    # Arrivals t0..t8; expected promotions unlocked AT each arrival.
+    expected = {
+        0: [], 1: [],
+        2: [(1, 0)],          # 3 results -> quota 1 -> t0 (best) climbs
+        3: [], 4: [],
+        5: [(1, 1)],          # 6 results -> quota 2 -> t1 joins
+        6: [], 7: [],
+        8: [(1, 2)],          # 9 results -> quota 3 -> t2 joins
+    }
+    for tid in range(9):
+        res = feed(sched, tid, 0, tid / 10.0)
+        assert res["promotions"] == expected[tid], f"arrival {tid}"
+        assert res["decision"] == "paused"
+    # Rung 1: the three climbers report; quota floor(3/3)=1 -> t0 only.
+    assert feed(sched, 0, 1, 0.01)["promotions"] == []
+    assert feed(sched, 1, 1, 0.11)["promotions"] == []
+    assert feed(sched, 2, 1, 0.21)["promotions"] == [(2, 0)]
+    # Top rung completes the trial instead of pausing it.
+    res = feed(sched, 0, 2, 0.001)
+    assert res["decision"] == "completed" and res["promotions"] == []
+
+    winner = sched.finalize()
+    assert winner.spec.trial_id == 0
+    counts = sched.counts()
+    assert counts["completed"] == 1
+    assert counts["pruned"] == 8          # everyone else swept
+    assert sched.epochs_spent == 9 * 1 + 3 * 2 + 1 * 6
+    assert sched.search_digest() is not None
+
+
+def test_asha_straggler_never_blocks():
+    """Async ASHA: promotions are granted per arrival, so eight results
+    promote climbers long before the ninth trial reports — and the
+    straggler, holding the global best loss, is promoted immediately on
+    its own arrival instead of waiting for a rung barrier."""
+    sched = make_sched()
+    promoted_before_straggler = []
+    for tid in range(1, 9):               # t0 is the straggler
+        promoted_before_straggler += feed(sched, tid, 0,
+                                          tid / 10.0)["promotions"]
+    # 8 results -> quota 2 granted without the straggler.
+    assert promoted_before_straggler == [(1, 1), (1, 2)]
+    res = feed(sched, 0, 0, 0.0)          # straggler: global best
+    assert (1, 0) in res["promotions"]    # promoted at its OWN arrival
+
+
+def test_duplicate_result_is_fenced():
+    sched = make_sched()
+    feed(sched, 0, 0, 0.5)
+    spent = sched.epochs_spent
+    res = sched.on_result(0, 0, 0.4)      # zombie re-report, better loss
+    assert res["duplicate"] and res["decision"] == "duplicate"
+    assert res["promotions"] == []
+    assert sched.epochs_spent == spent    # dynamics fenced too
+    assert sched.trials[0].rung_loss[0] == 0.5   # first write wins
+
+
+def test_plateau_completes_early():
+    """A collapsed delta-norm (PR 7 health-plane dynamics) retires the
+    trial as completed at its current rung — no promotion slot burned,
+    no further epochs."""
+    sched = make_sched(plateau_delta_norm=1e-3)
+    res = feed(sched, 0, 0, 0.5, delta_norm=1e-5)
+    assert res["decision"] == "plateau_completed"
+    assert sched.trials[0].status == "completed"
+    # A healthy delta-norm pauses normally.
+    res = feed(sched, 1, 0, 0.6, delta_norm=10.0)
+    assert res["decision"] == "paused"
+
+
+def test_winner_order_invariant():
+    """The same loss table driven through opposite arrival orders must
+    elect the same winner with the same search digest — the invariant
+    the chaos gate leans on."""
+
+    def drive(order):
+        sched = make_sched()
+        work = [(0, t) for t in order]
+        while work:
+            rung, tid = work.pop(0)
+            res = feed(sched, tid, rung, tid / 10.0 + rung)
+            work.extend(res["promotions"])
+        sched.finalize()
+        return sched
+
+    a = drive(list(range(9)))
+    b = drive(list(reversed(range(9))))
+    assert a.winner().spec.trial_id == b.winner().spec.trial_id == 0
+    assert a.search_digest() == b.search_digest()
+
+
+def test_stall_detection_on_fake_clock():
+    clock = FakeClock()
+    sched = make_sched(clock=clock, stall_after=30.0)
+    sched.on_lease(3, 0, "w0")
+    assert sched.stalled() == []
+    clock.advance(31.0)
+    assert sched.stalled() == [3]
+    # Progress re-arms the detector.
+    sched.on_result(3, 0, 0.3)
+    assert sched.stalled() == []
+
+
+# -- sampler ---------------------------------------------------------------
+
+
+def test_sampler_seed_determinism():
+    space = {
+        "lr": hp.loguniform(np.log(1e-4), np.log(1e-1)),
+        "width": hp.choice([16, 32, 64]),
+    }
+    a = sample_trials(space, 6, seed=7)
+    b = sample_trials(space, 6, seed=7)
+    c = sample_trials(space, 6, seed=8)
+    assert [t.digest for t in a] == [t.digest for t in b]
+    assert [t.config for t in a] == [t.config for t in b]
+    assert [t.seed for t in a] == [t.seed for t in b]
+    assert [t.digest for t in a] != [t.digest for t in c]
+    # Per-trial seeds are distinct (independent init streams).
+    assert len({t.seed for t in a}) == len(a)
+
+
+# -- vaults ----------------------------------------------------------------
+
+
+def test_memory_vault_roundtrip():
+    vault = MemoryVault()
+    assert vault.load(0) is None
+    state = {"x": np.arange(6, dtype=np.float32).reshape(2, 3),
+             "steps": np.asarray(12.0)}
+    vault.save(0, rung=1, loss=0.25, state=state)
+    ckpt = vault.load(0)
+    assert ckpt.rung == 1 and ckpt.loss == 0.25
+    np.testing.assert_array_equal(ckpt.state["x"], state["x"])
+    # Loaded leaves are writable copies (resume trains in place).
+    ckpt.state["x"][0, 0] = 99.0
+    np.testing.assert_array_equal(vault.load(0).state["x"], state["x"])
+
+
+class AdditiveFakeClient:
+    """Minimal PS stand-in: pull returns the store, push applies an
+    additive delta — the exact contract GroupVault's diffs target."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def get_parameters(self):
+        return self.store
+
+    def update_parameters(self, delta):
+        def add(a, b):
+            if isinstance(a, dict):
+                return {k: add(a[k], b[k]) for k in a}
+            return np.asarray(a) + np.asarray(b)
+
+        self.store = add(self.store, delta)
+
+
+def test_group_vault_roundtrip_additive():
+    template = {"x": np.zeros(4), "steps": np.asarray(0.0)}
+    store = GroupVault.build_store([0, 1], template)
+    vault = GroupVault(AdditiveFakeClient(store))
+    assert vault.load(0) is None          # rung=-1 sentinel
+    s0 = {"x": np.full(4, 2.5), "steps": np.asarray(4.0)}
+    s1 = {"x": np.full(4, -1.0), "steps": np.asarray(1.0)}
+    vault.save(0, 0, 0.5, s0)
+    vault.save(1, 1, 0.25, s1)            # disjoint trials compose
+    vault.save(0, 1, 0.125, s0)           # overwrite = diff to same value
+    c0, c1 = vault.load(0), vault.load(1)
+    assert (c0.rung, c0.loss) == (1, 0.125)
+    assert (c1.rung, c1.loss) == (1, 0.25)
+    np.testing.assert_allclose(c0.state["x"], s0["x"])
+    np.testing.assert_allclose(c1.state["x"], s1["x"])
+
+
+# -- ledger growth ----------------------------------------------------------
+
+
+def test_ledger_add_units_dedupes():
+    ledger = UnitLedger(1, [0, 1, 2])
+    unit = ledger.lease("w0")
+    assert unit == (0, 0)
+    ledger.complete("w0", unit)
+    # done, leased-elsewhere, pending, and genuinely-new units:
+    leased = ledger.lease("w1")           # (0, 1) now leased
+    added = ledger.add_units([(0, 0), leased, (0, 2), (1, 0), (1, 0)])
+    assert added == 1                     # only (1, 0), once
+    assert not ledger.all_done()
+    ledger.complete("w1", leased)
+    ledger.complete("w0", ledger.lease("w0"))   # (0, 2)
+    assert not ledger.all_done()          # the grown unit still pending
+    ledger.complete("w0", ledger.lease("w0"))   # (1, 0)
+    assert ledger.all_done()
+
+
+# -- end-to-end: resume after a mid-search worker death ---------------------
+
+
+def _staircase_trial_fn(config, state, epochs, seed, rung):
+    """Deterministic, resumable: loss = (tid+1) / (1 + total steps)."""
+    steps = float(state["steps"]) if state is not None else 0.0
+    steps += float(epochs)
+    loss = (config["tid"] + 1) / (1.0 + steps)
+    return {"loss": loss, "state": {"steps": np.asarray(steps)}}
+
+
+def _run(trial_fn, n=6, workers=("w0", "w1")):
+    specs = [TrialSpec(i, {"tid": i}, seed=i) for i in range(n)]
+    sched = AshaScheduler(specs, eta=3, rungs=3, r0=1,
+                          registry=MetricsRegistry(),
+                          flight=FlightRecorder(capacity=256))
+    runner = TuneRunner(trial_fn, sched, vault=MemoryVault(),
+                        worker_ids=workers,
+                        registry=MetricsRegistry(),
+                        flight=FlightRecorder(capacity=256))
+    return runner.run(), sched
+
+
+def test_resume_from_rung_after_worker_death():
+    """A worker dies mid-rung (trial_fn raises once at t0's rung-1
+    unit): the pool requeues the lease, a survivor resumes the trial
+    from its rung-0 vault checkpoint, and the search ends with zero
+    lost trials and the SAME winner + search digest as an undisturbed
+    run — the replay-stability the chaos gate enforces."""
+    clean, _ = _run(_staircase_trial_fn)
+
+    armed = {"live": True}
+
+    def killing_trial_fn(config, state, epochs, seed, rung):
+        if armed["live"] and config["tid"] == 0 and rung == 1:
+            armed["live"] = False
+            raise RuntimeError("injected mid-rung death")
+        return _staircase_trial_fn(config, state, epochs, seed, rung)
+
+    chaos, sched = _run(killing_trial_fn)
+    assert chaos["pool"]["worker_deaths"] == 1
+    assert chaos["pool"]["requeued_units"] >= 1
+    assert chaos["lost_trials"] == 0
+    assert sched.trials[0].resumed >= 1   # re-leased, not restarted
+    # Two owners for rung 1: the dead worker and the survivor.
+    assert len([o for o in sched.trials[0].owners if o[0] == 1]) == 2
+    assert chaos["winner_digest"] == clean["winner_digest"]
+    assert chaos["search_digest"] == clean["search_digest"]
+    assert chaos["best_loss"] == clean["best_loss"]
+
+
+def test_run_search_end_to_end_counters_and_doc():
+    reg = MetricsRegistry()
+    flight = FlightRecorder(capacity=256)
+    space = {"lr": hp.loguniform(np.log(1e-3), np.log(0.5)),
+             "width": hp.choice([8, 16])}
+
+    def trial_fn(config, state, epochs, seed, rung):
+        steps = float(state["steps"]) if state is not None else 0.0
+        steps += float(epochs)
+        loss = config["lr"] / (1.0 + steps)
+        return {"loss": loss, "state": {"steps": np.asarray(steps)}}
+
+    # 9 trials: with eta=3 every rung fills its promotion quota, so the
+    # ladder is climbed to the top (6 would strand rung 1 below quota).
+    doc = run_search(trial_fn, space, num_trials=9, seed=3, workers=2,
+                     registry=reg, flight=flight)
+    assert doc["lost_trials"] == 0
+    assert doc["winner_digest"] and doc["search_digest"]
+    n_terminal = doc["counts"]["pruned"] + doc["counts"]["completed"]
+    assert n_terminal == 9                # every trial reached a verdict
+    assert 0 < doc["epochs_spent"] < doc["full_budget_epochs"]
+    assert doc["trials"][str(doc["winner"]["trial"])]["status"] == "completed"
+    text = reg.expose_text()
+    assert "tune_epochs_total" in text
+    assert "tune_trials_promoted_total" in text
+    # Flight events stay inside the registered vocabulary.
+    from elephas_tpu.obs.flight import KINDS
+    kinds = {e.kind for e in flight.events()}
+    assert kinds <= set(KINDS)
+    assert "trial_promoted" in kinds and "trial_pruned" in kinds
+
+
+def test_run_search_digest_stable_across_worker_counts():
+    """Same seed, different pool widths -> different interleavings ->
+    identical winner and search digests (order invariance end to end)."""
+    space = {"lr": hp.uniform(0.1, 1.0)}
+
+    def trial_fn(config, state, epochs, seed, rung):
+        steps = float(state["steps"]) if state is not None else 0.0
+        steps += float(epochs)
+        return {"loss": config["lr"] / (1.0 + steps),
+                "state": {"steps": np.asarray(steps)}}
+
+    a = run_search(trial_fn, space, num_trials=9, seed=5, workers=1,
+                   registry=MetricsRegistry(),
+                   flight=FlightRecorder(capacity=64))
+    b = run_search(trial_fn, space, num_trials=9, seed=5, workers=3,
+                   registry=MetricsRegistry(),
+                   flight=FlightRecorder(capacity=64))
+    assert a["winner_digest"] == b["winner_digest"]
+    assert a["search_digest"] == b["search_digest"]
+
+
+def test_trials_snapshot_shape():
+    specs = [TrialSpec(i, {"tid": i}, seed=i) for i in range(3)]
+    sched = AshaScheduler(specs, eta=3, rungs=2,
+                          registry=MetricsRegistry(),
+                          flight=FlightRecorder(capacity=16))
+    runner = TuneRunner(_staircase_trial_fn, sched,
+                        registry=MetricsRegistry(),
+                        flight=FlightRecorder(capacity=16))
+    runner.run()
+    snap = runner.trials_snapshot()
+    assert set(snap) >= {"eta", "rungs", "r0", "counts", "epochs_spent",
+                         "best", "search_digest", "trials", "units"}
+    assert len(snap["trials"]) == 3
+    for card in snap["trials"].values():
+        assert {"trial", "digest", "status", "rung", "loss", "top_rung",
+                "resumed", "owners"} <= set(card)
