@@ -349,6 +349,33 @@ def sample_tokens(logits, key, greedy, top_k, temperature):
     )
 
 
+def sample_tokens_at(logits, base_key, positions, greedy, top_k,
+                     temperature):
+    """Position-deterministic sampling: like ``sample_tokens`` but the
+    PRNG key for each row is ``fold_in(base_key, positions[row])`` —
+    the pad-free sequence position of the token being sampled. Any two
+    programs that sample the same position of the same stream (plain
+    decode, chunked prefill, speculative draft/verify) therefore draw
+    the SAME random number, which is what makes speculative decode
+    byte-identical to plain decode at temperature > 0, not just greedy.
+
+    ``logits``: (N, vocab); ``positions``: (N,) int32. Greedy ignores
+    the key entirely (argmax)."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(
+            logits >= kth, logits, jnp.finfo(logits.dtype).min
+        )
+    sample_row = jax.vmap(
+        lambda row, p: jax.random.categorical(
+            jax.random.fold_in(base_key, p), row / temperature
+        )
+    )
+    return sample_row(logits, positions).astype(jnp.int32)
+
+
 # Trace-time counter: the traced body runs ONCE per compilation, so this
 # counts compiles — tests assert ragged batches of varying lengths reuse
 # one program (recompiles only on genuine shape/static changes).

@@ -86,6 +86,10 @@ KINDS = (
     "trial_pruned",
     "trial_resumed",
     "trial_stalled",
+    # speculative serving decode (serving/spec.py): the draft source
+    # failed to produce params (e.g. PS pull error) and the decoder
+    # degraded to plain decode for that window instead of erroring
+    "spec_fallback",
 )
 
 

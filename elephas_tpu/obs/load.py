@@ -67,12 +67,14 @@ class LoadSnapshot:
         "t", "queue_depth", "queue_limit", "active", "max_slots",
         "kv_free_frac", "admit_rate", "reject_rate", "tokens_per_s",
         "kv_blocks_free", "kv_blocks_total", "prefix_hit_rate",
+        "spec_accept_rate", "spec_tokens_per_step",
     )
 
     def __init__(self, *, t, queue_depth, queue_limit, active, max_slots,
                  kv_free_frac, admit_rate=0.0, reject_rate=0.0,
                  tokens_per_s=0.0, kv_blocks_free=None,
-                 kv_blocks_total=None, prefix_hit_rate=None):
+                 kv_blocks_total=None, prefix_hit_rate=None,
+                 spec_accept_rate=None, spec_tokens_per_step=None):
         self.t = float(t)
         self.queue_depth = int(queue_depth)
         self.queue_limit = max(1, int(queue_limit))
@@ -94,6 +96,17 @@ class LoadSnapshot:
         )
         self.prefix_hit_rate = (
             None if prefix_hit_rate is None else float(prefix_hit_rate)
+        )
+        # Speculative-decode extras (None unless the engine runs
+        # speculative=True and has harvested at least one window): the
+        # trailing draft-token accept rate and emitted tokens per
+        # lane-step a router/operator reads for decode efficiency.
+        self.spec_accept_rate = (
+            None if spec_accept_rate is None else float(spec_accept_rate)
+        )
+        self.spec_tokens_per_step = (
+            None if spec_tokens_per_step is None
+            else float(spec_tokens_per_step)
         )
 
     @property
@@ -122,6 +135,10 @@ class LoadSnapshot:
             out["kv_blocks_free"] = self.kv_blocks_free
             out["kv_blocks_total"] = self.kv_blocks_total
             out["prefix_hit_rate"] = self.prefix_hit_rate
+        if self.spec_accept_rate is not None:
+            out["spec_accept_rate"] = self.spec_accept_rate
+        if self.spec_tokens_per_step is not None:
+            out["spec_tokens_per_step"] = self.spec_tokens_per_step
         return out
 
 
@@ -203,6 +220,7 @@ class LoadTracker:
         self._raw: Optional[float] = None
         self._observations = 0
         self._registry_gauge = None  # lazy; False after a failed bind
+        self._spec_gauges = None  # lazy; False after a failed bind
 
     def _mirror(self, value: float) -> None:
         if self._registry_gauge is None:
@@ -217,10 +235,37 @@ class LoadTracker:
         if self._registry_gauge:
             self._registry_gauge.set(value)
 
+    def _mirror_spec(self, accept_rate, tokens_per_step) -> None:
+        """Federate the speculative-decode gauges per proc, same
+        lazy/latched discipline as the load-score mirror."""
+        if self._spec_gauges is None:
+            try:
+                from elephas_tpu import obs
+                reg = obs.default_registry()
+                self._spec_gauges = (
+                    reg.gauge(
+                        "serving_spec_accept_rate",
+                        help="draft tokens accepted / drafted in [0,1]",
+                    ),
+                    reg.gauge(
+                        "serving_spec_tokens_per_step",
+                        help="tokens emitted per speculative lane-step",
+                    ),
+                )
+            except Exception:
+                self._spec_gauges = False
+        if self._spec_gauges:
+            if accept_rate is not None:
+                self._spec_gauges[0].set(accept_rate)
+            if tokens_per_step is not None:
+                self._spec_gauges[1].set(tokens_per_step)
+
     def observe(self, *, queue_depth, queue_limit, active, max_slots,
                 kv_free_frac, admitted_total=0, rejected_total=0,
                 tokens_total=0, now=None, kv_blocks_free=None,
-                kv_blocks_total=None, prefix_hit_rate=None) -> LoadSnapshot:
+                kv_blocks_total=None, prefix_hit_rate=None,
+                spec_accept_rate=None,
+                spec_tokens_per_step=None) -> LoadSnapshot:
         now = self.clock() if now is None else float(now)
         with self._lock:
             self._admitted.push(now, float(admitted_total))
@@ -236,12 +281,16 @@ class LoadTracker:
                 kv_blocks_free=kv_blocks_free,
                 kv_blocks_total=kv_blocks_total,
                 prefix_hit_rate=prefix_hit_rate,
+                spec_accept_rate=spec_accept_rate,
+                spec_tokens_per_step=spec_tokens_per_step,
             )
             self._raw = instant_load(snap)
             score = self.score.update(self._raw, now)
             self._last = snap
             self._observations += 1
         self._mirror(score)
+        if spec_accept_rate is not None or spec_tokens_per_step is not None:
+            self._mirror_spec(spec_accept_rate, spec_tokens_per_step)
         return snap
 
     def snapshot(self) -> Dict[str, object]:

@@ -27,6 +27,13 @@ decode step into an engine that serves request traffic:
                          tokens-per-sec / dispatch→fetch device overlap
                          through ``metrics.JsonlSink``
                          (``serving.metrics``),
+- ``SpeculativeDecoder`` — draft-and-verify decode over the paged pool:
+                         a ``DraftSource`` (shallow-stack self-draft or
+                         a PS-delivered small draft model) proposes
+                         gamma tokens per slot, ONE batched target
+                         forward verifies them, emitted streams stay
+                         byte-identical to plain decode
+                         (``serving.spec``),
 - ``host_sync``        — the ONE sanctioned device→host sync point;
                          ``scripts/lint_blocking.py`` statically bans
                          blocking reads anywhere else in this package,
@@ -66,6 +73,12 @@ from elephas_tpu.serving.engine import (  # noqa: F401
     shard_serving,
 )
 from elephas_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from elephas_tpu.serving.spec import (  # noqa: F401
+    DraftModelSource,
+    DraftSource,
+    SelfDraftSource,
+    SpeculativeDecoder,
+)
 from elephas_tpu.serving.fleet import (  # noqa: F401
     FleetAutoscaler,
     FleetUnavailable,

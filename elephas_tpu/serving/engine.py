@@ -121,6 +121,21 @@ class InferenceEngine:
         admission). Set to a small int to bound how long any one step's
         prefill work can stall in-flight decodes — the ITL-p99
         protection the chunked program exists for.
+    speculative: draft-and-verify decode (paged only; default False).
+        Each pipelined dispatch drafts ``gamma`` tokens per slot with a
+        cheap draft source and verifies the whole window in ONE batched
+        target forward — between 1 and ``gamma + 1`` tokens emitted per
+        step, byte-identical to plain decode by construction (see
+        ``serving.spec``). Plain decode stays the oracle.
+    gamma: draft window length per speculation step (default 4).
+    draft_layers: shallow-stack SELF-draft — the target's first K layers
+        draft with zero extra weights (default ``num_layers // 2`` when
+        ``speculative`` and no ``draft_source`` given).
+    draft_source: an explicit ``serving.spec.DraftSource`` (e.g.
+        ``DraftModelSource`` pulling a small draft model version-gated
+        from a parameter-server client). Mutually exclusive with
+        ``draft_layers``; model sources require ``prefix_cache=False``
+        (a refcount-admitted prefix would leave the draft cache cold).
     sink: optional ``metrics.JsonlSink`` for request/step records.
     tracer: optional ``obs.Tracer`` recording the per-request span tree
         (submit→queue→admit→prefill→decode→finish, one ``req:<id>``
@@ -151,6 +166,10 @@ class InferenceEngine:
         prefix_cache: bool = True,
         prefill_chunk: Optional[int] = None,
         prefill_chunks_per_step: Optional[int] = None,
+        speculative: bool = False,
+        gamma: int = 4,
+        draft_layers: Optional[int] = None,
+        draft_source=None,
         sink=None,
         clock=time.monotonic,
         tracer=None,
@@ -186,6 +205,18 @@ class InferenceEngine:
 
         self.tracer = tracer if tracer is not None else obs.default_tracer()
         self.paged = paged
+        if (draft_layers is not None or draft_source is not None) \
+                and not speculative:
+            raise ValueError(
+                "draft_layers/draft_source require speculative=True"
+            )
+        if speculative:
+            if not paged:
+                raise ValueError("speculative decode requires paged=True")
+            if draft_layers is not None and draft_source is not None:
+                raise ValueError(
+                    "draft_layers and draft_source are mutually exclusive"
+                )
         if paged:
             chunk = (prefill_chunk if prefill_chunk is not None
                      else max_prompt_len)
@@ -195,16 +226,20 @@ class InferenceEngine:
                     f"[1, max_prompt_len={max_prompt_len}]"
                 )
             self.prefill_chunk = chunk
+            # A chunk may start as late as the last prompt column; its
+            # compiled slice/scatter window must fit the virtual row
+            # without clamping. A speculative verify window writes up to
+            # gamma columns past the last decode column the same way.
+            virtual_len = max_prompt_len - 1 + chunk
+            if speculative:
+                virtual_len = max(virtual_len, max_len + gamma)
             self.pool = PagedKVPool(
                 self.decode_module, max_slots, max_len,
                 block_size=(kv_block_size if kv_block_size is not None
                             else max_prompt_len),
                 num_blocks=kv_blocks,
                 prefix_cache=prefix_cache,
-                # A chunk may start as late as the last prompt column;
-                # its compiled slice/scatter window must fit the virtual
-                # row without clamping.
-                virtual_len=max_prompt_len - 1 + chunk,
+                virtual_len=virtual_len,
             )
         else:
             if (kv_block_size is not None or kv_blocks is not None
@@ -216,6 +251,24 @@ class InferenceEngine:
                 )
             self.prefill_chunk = None
             self.pool = KVCachePool(self.decode_module, max_slots, max_len)
+        self.spec = None
+        if speculative:
+            from elephas_tpu.serving.spec import (
+                SelfDraftSource,
+                SpeculativeDecoder,
+            )
+
+            if draft_source is None:
+                layers = (draft_layers if draft_layers is not None
+                          else max(1, self.decode_module.num_layers // 2))
+                draft_source = SelfDraftSource(layers)
+            if draft_source.kind == "model" and prefix_cache:
+                raise ValueError(
+                    "a model draft source requires prefix_cache=False: a "
+                    "prefix-matched admission fills the target pool by "
+                    "refcount and would leave the draft cache cold"
+                )
+            self.spec = SpeculativeDecoder(self, draft_source, gamma=gamma)
         self.queue = RequestQueue(max_depth=queue_depth)
         self.metrics = ServingMetrics(sink=sink, clock=clock)
         # Saturation + goodput plane, both on the engine's clock: the
@@ -239,6 +292,9 @@ class InferenceEngine:
             chunk_prefill_fn=self._chunk_prefill if paged else None,
             prefill_chunk=self.prefill_chunk,
             prefill_chunks_per_step=prefill_chunks_per_step,
+            spec_decode_fn=(self.spec.dispatch if self.spec is not None
+                            else None),
+            gamma=gamma if speculative else None,
         )
 
         self._prefill_traces = 0
@@ -305,7 +361,7 @@ class InferenceEngine:
         note_retrace("serving_prefill", count=self._prefill_traces)
         from elephas_tpu.models.transformer import (
             make_decode_cache,
-            sample_tokens,
+            sample_tokens_at,
         )
 
         cache = make_decode_cache(
@@ -317,8 +373,14 @@ class InferenceEngine:
             pad_offset=pad_offset[None],
             mutable=["cache"],
         )
-        first = sample_tokens(
-            logits[:, -1], rng, self._greedy, self.top_k, self.temperature
+        # Position-keyed sampling: the token after a plen-token prompt
+        # sits at pad-free stream position plen — every program (plain
+        # decode, chunked prefill, speculative verify) derives the same
+        # key for the same position, which is what makes temperature
+        # decode byte-identical across all of them.
+        first = sample_tokens_at(
+            logits[:, -1], rng, (prompt.shape[1] - pad_offset)[None],
+            self._greedy, self.top_k, self.temperature,
         )
         return first[0], mutated["cache"]
 
@@ -328,8 +390,14 @@ class InferenceEngine:
         from elephas_tpu.utils.compiler import note_retrace
 
         note_retrace("serving_decode", count=self._decode_traces)
-        from elephas_tpu.models.transformer import sample_tokens
+        from elephas_tpu.models.transformer import sample_tokens_at
 
+        # Pre-advance cache index per lane (first leaf speaks for all):
+        # the token sampled this step sits at pad-free position
+        # idx - pad + 1.
+        flat = jax.tree_util.tree_flatten_with_path(cache)[0]
+        idx = next(leaf for path, leaf in flat
+                   if self._leaf_name(path) == "cache_index")
         # Freshly-admitted lanes get their prefill first token here,
         # INSIDE the one compiled program — the pipelined scheduler
         # never materializes the token vector host-side.
@@ -341,8 +409,9 @@ class InferenceEngine:
             active=active_mask,
             mutable=["cache"],
         )
-        nxt = sample_tokens(
-            logits[:, -1], rng, self._greedy, self.top_k, self.temperature
+        nxt = sample_tokens_at(
+            logits[:, -1], rng, idx - pad + 1, self._greedy, self.top_k,
+            self.temperature,
         )
         return nxt, mutated["cache"]
 
@@ -369,7 +438,7 @@ class InferenceEngine:
         from elephas_tpu.utils.compiler import note_retrace
 
         note_retrace("serving_prefill", count=self._prefill_traces)
-        from elephas_tpu.models.transformer import sample_tokens
+        from elephas_tpu.models.transformer import sample_tokens_at
         from elephas_tpu.ops.attention import (
             scatter_prefill_columns,
             slot_row_to_contiguous,
@@ -397,8 +466,11 @@ class InferenceEngine:
         # (only the final chunk's sample is ever read).
         last = jax.lax.dynamic_slice_in_dim(logits, valid - 1, 1,
                                             axis=1)[:, 0]
-        first = sample_tokens(
-            last, rng, self._greedy, self.top_k, self.temperature
+        # Paged rows are never left-padded, so the sampled token's
+        # pad-free position is simply the prefilled depth start + valid.
+        first = sample_tokens_at(
+            last, rng, (start + valid)[None], self._greedy, self.top_k,
+            self.temperature,
         )
 
         def back(path, pool_leaf, mut_leaf):
@@ -431,7 +503,7 @@ class InferenceEngine:
         from elephas_tpu.utils.compiler import note_retrace
 
         note_retrace("serving_decode", count=self._decode_traces)
-        from elephas_tpu.models.transformer import sample_tokens
+        from elephas_tpu.models.transformer import sample_tokens_at
         from elephas_tpu.ops.attention import (
             paged_to_contiguous,
             scatter_decode_columns,
@@ -457,8 +529,9 @@ class InferenceEngine:
             active=active_mask,
             mutable=["cache"],
         )
-        nxt = sample_tokens(
-            logits[:, -1], rng, self._greedy, self.top_k, self.temperature
+        nxt = sample_tokens_at(
+            logits[:, -1], rng, idx - pad + 1, self._greedy, self.top_k,
+            self.temperature,
         )
 
         def back(path, pool_leaf, mut_leaf):
@@ -473,10 +546,14 @@ class InferenceEngine:
         return nxt, new_cache
 
     def _next_rng(self):
-        if self._greedy:
-            return self._rng  # unused by greedy sampling; keep it constant
-        self._rng, sub = jax.random.split(self._rng)
-        return sub
+        # Sampling keys derive from (base key, pad-free stream position)
+        # via fold_in inside the programs (``sample_tokens_at``), so the
+        # engine key is a CONSTANT: the n-th token of a stream draws the
+        # same random number no matter which program (plain decode,
+        # chunked prefill, speculative draft/verify) samples it, or how
+        # many device calls preceded it. That positional determinism is
+        # the whole temperature-identity story.
+        return self._rng
 
     def _prefill(self, prompt, pad_offset):
         if self.paged:
@@ -498,6 +575,10 @@ class InferenceEngine:
             tokens, slot, start, valid, self._next_rng(),
         )
         self.pool.swap(new_cache)
+        if self.spec is not None:
+            # Model draft sources mirror every prompt chunk into their
+            # own cache (no-op for self-draft, which reads the pool).
+            self.spec.prefill_chunk(tokens, slot, start, valid)
         return first
 
     def _decode(self, cache, prev_tokens, override_vals, override_mask,
@@ -594,6 +675,14 @@ class InferenceEngine:
                     (repl, pool_sh),                           # decode
                 ),
             )
+            if self.spec is not None:
+                if self.spec.source.kind != "self":
+                    raise NotImplementedError(
+                        "tensor-parallel serving with a model draft "
+                        "source is not supported yet (the draft model "
+                        "has no sharding rules); use a self-draft"
+                    )
+                self.spec.make_jits(p_sh, pool_sh, repl)
             self.mesh = mesh
             return self
         prefill_cache = make_decode_cache(self.decode_module, 1,
@@ -798,6 +887,8 @@ class InferenceEngine:
             out["kv_blocks_free"] = self.pool.free_blocks
             out["kv_blocks_total"] = self.pool.num_blocks
             out.update(self.pool.prefix_stats())
+        if self.spec is not None:
+            out.update(self.spec.stats())
         return out
 
     def mount_ops(self, port: int = 0, host: Optional[str] = None,

@@ -59,6 +59,13 @@ class ServingMetrics:
         self.histograms = {k: Histogram(k) for k in _LATENCY_KEYS}
         self._last_overlap: Optional[float] = None
         self._t0: Optional[float] = None
+        # Speculative decode aggregates (zero unless the engine runs
+        # with speculative=True): lane-windows harvested, draft tokens
+        # proposed/accepted, tokens emitted by speculative harvests.
+        self.spec_windows = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
         # Lazy process-registry mirror of the ITL distribution: the SLO
         # alert pack's serving rule reads ``serving_itl_seconds_p99``
         # from registry snapshots, which the private per-engine
@@ -85,6 +92,10 @@ class ServingMetrics:
         self.histograms = {k: Histogram(k) for k in _LATENCY_KEYS}
         self._last_overlap = None
         self._t0 = None
+        self.spec_windows = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
 
     # -- request lifecycle -------------------------------------------------
 
@@ -132,9 +143,35 @@ class ServingMetrics:
                 ttft_s=result.ttft_s,
                 itl_s_avg=result.itl_s_avg,
                 tokens_per_sec=result.tokens_per_sec,
+                tokens_per_step=result.tokens_per_step,
                 queue_depth=queue_depth,
                 active_slots=active,
             )
+
+    # -- speculative decode ------------------------------------------------
+
+    def record_spec(self, *, windows: int, drafted: int, accepted: int,
+                    emitted: int) -> None:
+        """One speculative harvest: ``windows`` lane-windows read back,
+        ``drafted`` draft tokens proposed (gamma per lane), ``accepted``
+        of them matching the target, ``emitted`` tokens appended to
+        streams (accepted + bonus, minus stop/budget truncation)."""
+        self.spec_windows += windows
+        self.spec_drafted += drafted
+        self.spec_accepted += accepted
+        self.spec_emitted += emitted
+
+    @property
+    def spec_accept_rate(self) -> Optional[float]:
+        if self.spec_drafted == 0:
+            return None
+        return self.spec_accepted / self.spec_drafted
+
+    @property
+    def spec_tokens_per_step(self) -> Optional[float]:
+        if self.spec_windows == 0:
+            return None
+        return self.spec_emitted / self.spec_windows
 
     # -- scheduler cadence -------------------------------------------------
 
@@ -183,6 +220,10 @@ class ServingMetrics:
                 self.tokens_out / elapsed if elapsed else None
             ),
         }
+        if self.spec_windows:
+            out["spec_windows"] = self.spec_windows
+            out["spec_accept_rate"] = self.spec_accept_rate
+            out["spec_tokens_per_step"] = self.spec_tokens_per_step
         # Tail latencies (bucketed estimates, obs.Histogram): averages
         # hide exactly the stall spikes serving SLOs are written against.
         for key, hist in self.histograms.items():

@@ -88,6 +88,11 @@ class GenerationResult:
     ttft_s: Optional[float] = None
     itl_s_avg: Optional[float] = None
     tokens_per_sec: Optional[float] = None
+    # Decode tokens per decode step: exactly 1.0 on the plain path,
+    # up to gamma + 1 under speculative decode (multi-token harvests
+    # would otherwise silently under-report ITL). The prefill-produced
+    # first token is excluded — it cost no decode step.
+    tokens_per_step: Optional[float] = None
 
 
 class RequestQueue:
@@ -135,6 +140,7 @@ class _Active:
     budget: int                          # tokens still allowed (cache cap)
     admitted_at: float = 0.0             # decode-batch join time (spans)
     next_col: int = 0                    # paged: column the next decode writes
+    steps: int = 0                       # decode steps harvested (ITL unit)
 
 
 @dataclass
@@ -161,6 +167,12 @@ class _Inflight:
     tokens: Any                          # (max_slots,) device token vector
     lanes: List[Tuple[int, _Active]]     # entries occupying lanes at dispatch
     dispatched_at: float = 0.0
+    # Speculative windows: ragged per-lane harvest state. ``tokens``
+    # stays the (max_slots,) NEXT-input vector (the accepted frontier's
+    # target sample) so lookahead chaining is mode-blind.
+    spec: bool = False
+    spec_emitted: Any = None             # (max_slots, gamma + 1) device
+    spec_accepted: Any = None            # (max_slots,) device
 
 
 class ContinuousBatchingScheduler:
@@ -192,6 +204,18 @@ class ContinuousBatchingScheduler:
         (``prefill_chunks_per_step`` bounds chunks dispatched per step;
         None runs every pending chunk at admission), block backing per
         decode column, and chain-publishing release.
+    ``spec_decode_fn(cache, prev_tokens, override_vals, override_mask,
+    active_mask, pad) -> (last, emitted, accepted) | None``
+        speculative decode (paged + chunked prefill only): ONE
+        draft-and-verify window over all lanes. ``last`` chains as the
+        next dispatch's ``prev_tokens`` exactly like ``decode_fn``'s
+        output; ``emitted`` is the (max_slots, gamma + 1) matrix of
+        target samples and ``accepted`` the per-lane matching-prefix
+        lengths — the harvest appends ``emitted[s, :accepted[s] + 1]``
+        per lane (ragged, device-rolled-back past that). A None return
+        means the draft source failed for this window (flight-recorded
+        as ``spec_fallback``) and the scheduler runs one plain
+        ``decode_fn`` step instead — token-identical either way.
     """
 
     def __init__(
@@ -210,6 +234,8 @@ class ContinuousBatchingScheduler:
         chunk_prefill_fn: Optional[Callable] = None,
         prefill_chunk: Optional[int] = None,
         prefill_chunks_per_step: Optional[int] = None,
+        spec_decode_fn: Optional[Callable] = None,
+        gamma: Optional[int] = None,
     ):
         self.pool = pool
         self.queue = queue
@@ -221,6 +247,11 @@ class ContinuousBatchingScheduler:
             prefill_chunk if prefill_chunk is not None else max_prompt_len
         )
         self.prefill_chunks_per_step = prefill_chunks_per_step
+        self.spec_decode_fn = spec_decode_fn
+        self.gamma = gamma
+        if spec_decode_fn is not None and not self.paged:
+            raise ValueError("spec_decode_fn requires the paged path "
+                             "(chunk_prefill_fn)")
         self.max_prompt_len = max_prompt_len
         self.pad_token = pad_token
         self.metrics = metrics
@@ -290,6 +321,11 @@ class ContinuousBatchingScheduler:
             itl_s_avg=itl,
             tokens_per_sec=(
                 len(entry.tokens) / span if span and span > 0 else None
+            ),
+            # First token excluded: prefill produced it, no decode step.
+            tokens_per_step=(
+                (len(entry.tokens) - 1) / entry.steps
+                if entry.steps > 0 else None
             ),
         )
         if self.tracer.enabled:
@@ -574,6 +610,40 @@ class ContinuousBatchingScheduler:
         lanes = sorted(self._active.items())
         for slot, _ in lanes:
             active_mask[slot] = True
+        if self.spec_decode_fn is not None:
+            # Conservatively back TWO windows of columns per lane before
+            # the closure snapshots the device block table: window N
+            # writes [next_col, next_col + gamma], and the pipelined
+            # window N+1 dispatches before N's harvest, so its writes
+            # land no further than next_col + 2*gamma + 1. next_col
+            # itself advances at HARVEST (by accepted + 1) on this path
+            # — it must keep counting columns whose K/V write is
+            # device-ordered, and a speculative write past the accepted
+            # frontier is not one.
+            for slot, entry in lanes:
+                upto = min(entry.next_col + 2 * (self.gamma + 1),
+                           self.pool.virtual_len)
+                for col in range(entry.next_col, upto):
+                    self.pool.ensure_decode_col(slot, col)
+            out = self.spec_decode_fn(
+                self.pool.cache, prev_tokens, override_vals,
+                override_mask, active_mask, self.pool.pad,
+            )
+            if out is not None:
+                last, emitted, accepted = out
+                dispatched_at = self.clock()
+                self.tracer.record(
+                    "dispatch", t0, dispatched_at, lanes=len(lanes),
+                    spec=True,
+                )
+                return _Inflight(
+                    tokens=last, lanes=lanes, dispatched_at=dispatched_at,
+                    spec=True, spec_emitted=emitted, spec_accepted=accepted,
+                )
+            # Draft source failed (spec_fallback flight-recorded by the
+            # decoder): degrade to ONE plain decode step — the blocks
+            # backed above stay owned, and the plain path's
+            # advance-at-dispatch accounting below takes over for it.
         if self.paged:
             # Back (and exclusively own) the column each lane writes
             # this step BEFORE the engine closure snapshots the device
@@ -609,6 +679,8 @@ class ContinuousBatchingScheduler:
         Lanes whose entry finished or was evicted AFTER dispatch are
         skipped — their computed token is the one wasted lane-iteration
         pipelining costs on stop detection."""
+        if inflight.spec:
+            return self._harvest_spec(inflight)
         live = [
             (slot, entry) for slot, entry in inflight.lanes
             if self._active.get(slot) is entry
@@ -630,6 +702,7 @@ class ContinuousBatchingScheduler:
         for (slot, entry), (_, tok) in zip(live, fetched):
             entry.tokens.append(tok)
             entry.token_times.append(now)
+            entry.steps += 1
             emitted += 1
             if tok == entry.request.stop_token or \
                     len(entry.tokens) >= entry.budget:
@@ -638,6 +711,66 @@ class ContinuousBatchingScheduler:
                 # The lane's next input rides the device chain; a stale
                 # override from a previous occupancy must not clobber it.
                 self._overrides.pop(slot, None)
+        return emitted
+
+    def _harvest_spec(self, inflight: _Inflight) -> int:
+        """Ragged speculative harvest: lane ``s`` gained
+        ``accepted[s] + 1`` tokens this window — the target's own
+        samples, truncated host-side at stop token / budget exactly
+        where the plain path would have stopped.
+
+        ``next_col`` advances by ``accepted + 1`` (the device frontier's
+        advance): every column below the new frontier has its K/V write
+        device-ordered, and the frontier token itself — like plain
+        decode's newest token — is K/V-unwritten until the next window
+        consumes it. ``_finish``'s chain slice therefore publishes
+        exactly the deterministically-written columns; on a truncated
+        window the Python slice clamps to the shorter token list, whose
+        last token was a draft INPUT this window (K/V written)."""
+        live = [
+            (slot, entry) for slot, entry in inflight.lanes
+            if self._active.get(slot) is entry
+        ]
+        if not live:
+            return 0
+        em = host_sync.fetch(inflight.spec_emitted)    # (S, gamma+1)
+        ac = host_sync.fetch(inflight.spec_accepted)   # (S,)
+        now = self.clock()
+        if self.metrics is not None:
+            self.metrics.record_overlap(now - inflight.dispatched_at)
+        self.tracer.record(
+            "decode_step", inflight.dispatched_at, now, lanes=len(live),
+            spec=True,
+        )
+        emitted = 0
+        accepted_sum = 0
+        for slot, entry in live:
+            a = int(ac[slot])  # host-ok: harvested device scalar
+            accepted_sum += a
+            entry.steps += 1
+            entry.next_col += a + 1
+            finished = False
+            for off in range(a + 1):
+                tok = int(em[slot, off])  # host-ok: harvested device token
+                entry.tokens.append(tok)
+                entry.token_times.append(now)
+                emitted += 1
+                if tok == entry.request.stop_token or \
+                        len(entry.tokens) >= entry.budget:
+                    self._finish(entry, "completed")
+                    finished = True
+                    break
+            if not finished:
+                # Next input rides the device chain (the frontier
+                # sample); drop any stale override for this slot.
+                self._overrides.pop(slot, None)
+        if self.metrics is not None:
+            self.metrics.record_spec(
+                windows=len(live),
+                drafted=self.gamma * len(live),
+                accepted=accepted_sum,
+                emitted=emitted,
+            )
         return emitted
 
     def _step_pipelined(self) -> int:
@@ -713,6 +846,16 @@ class ContinuousBatchingScheduler:
                 kv_blocks_free=kv.get("kv_blocks_free"),
                 kv_blocks_total=kv.get("kv_blocks_total"),
                 prefix_hit_rate=kv.get("prefix_hit_rate"),
+                spec_accept_rate=(
+                    self.metrics.spec_accept_rate
+                    if self.metrics is not None
+                    and self.spec_decode_fn is not None else None
+                ),
+                spec_tokens_per_step=(
+                    self.metrics.spec_tokens_per_step
+                    if self.metrics is not None
+                    and self.spec_decode_fn is not None else None
+                ),
             )
         return self._results[before:]
 

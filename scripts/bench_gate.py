@@ -89,6 +89,22 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             ("prefix_hit_rate", "floor", 0.5),
             ("token_identical", "equal", 0.0),
             ("chunked_itl_ratio", "limit", 1.0),
+            # Speculative-decoding --spec row. token_identical reuses
+            # the equal-rule above (spec streams must match the
+            # unspeculated oracle request-for-request — identity is the
+            # whole contract). The accept-rate floor gates the draft
+            # MECHANICS on the shared-prefix workload: the bench's
+            # PS-delivered draft carries the target's own weights, so
+            # anything under ~1.0 means the draft cache, rollback, or
+            # frontier bookkeeping broke (breakage there sinks
+            # acceptance silently — it never corrupts tokens).
+            # tokens_per_step > 1.3 is the reason speculation exists;
+            # spec_itl_ratio (per-token: spec step cost / tokens-per-
+            # step, over the plain engine's one-token steps) must not
+            # trade the latency away.
+            ("spec_accept_rate", "floor", 0.5),
+            ("tokens_per_step", "floor", 1.3),
+            ("spec_itl_ratio", "limit", 1.0),
             # Durable-telemetry row (--store-overhead): overhead_pct is
             # already ceilinged above (the rule table is a superset over
             # row shapes); within_2pct pins the bench's own verdict bit,

@@ -6,7 +6,9 @@ process's opsd URL and get the merged picture — who is alive/stale/dead
 (with boot ids, so a warm restart is visible as the same slot coming
 back different), per-process LOAD (EWMA saturation score from ``/load``)
 and GOODPUT (worst-objective SLO attainment from ``/slo``; both render
-``-`` for stale/dead procs), DISK (durable telemetry journal bytes from
+``-`` for stale/dead procs), SPEC (speculative-decode accept rate and
+realized tokens/step from the ``/load`` signals; ``-`` for engines not
+speculating), DISK (durable telemetry journal bytes from
 the federated ``obs_store_bytes`` gauge + seconds since the last
 persisted record via ``/incidents``; ``-`` when stale/dead or no store
 is mounted), the fleet-summed counters, pooled histogram
@@ -70,6 +72,26 @@ def _kv_cell(snap: dict, name: str, status: str) -> str:
     rate = sig.get("prefix_hit_rate")
     if rate is not None:
         cell += f"({100.0 * rate:.0f}%)"
+    return cell
+
+
+def _spec_cell(snap: dict, name: str, status: str) -> str:
+    """SPEC column: speculative-decode health from the proc's /load
+    signals — draft accept rate with realized tokens/step in
+    parentheses. '-' for stale/dead procs and for engines not running
+    speculative decode (the signals are absent by construction, same
+    contract as the KV column for contiguous pools)."""
+    if status != "alive":
+        return "-"
+    doc = (snap.get("load") or {}).get(name) or {}
+    sig = doc.get("signals") or {}
+    rate = sig.get("spec_accept_rate")
+    if rate is None:
+        return "-"
+    cell = f"{100.0 * rate:.0f}%"
+    tps = sig.get("spec_tokens_per_step")
+    if tps is not None:
+        cell += f"({tps:.1f})"
     return cell
 
 
@@ -164,7 +186,7 @@ def render(snap: dict) -> str:
     # ("ps/shard0", "ps/standby"), not just the flat "ps"/"worker".
     lines.append(f"{'NAME':<10} {'ROLE':<12} {'STATUS':<7} {'BOOT':<14} "
                  f"{'WORKER':<8} {'LAST OK':>8} {'LOAD':>5} {'GOODPUT':>8} "
-                 f"{'KV':>13} {'DISK':>11}  URL")
+                 f"{'KV':>13} {'SPEC':>10} {'DISK':>11}  URL")
     for name, p in sorted(snap["processes"].items()):
         meta = p.get("meta") or {}
         ago = p.get("last_ok_s_ago")
@@ -176,6 +198,7 @@ def render(snap: dict) -> str:
             f"{_load_cell(snap, name, p['status']):>5} "
             f"{_goodput_cell(snap, name, p['status']):>8} "
             f"{_kv_cell(snap, name, p['status']):>13} "
+            f"{_spec_cell(snap, name, p['status']):>10} "
             f"{_disk_cell(snap, name, p['status']):>11}  {p['url']}"
         )
     metrics = snap["metrics"]

@@ -19,6 +19,12 @@ artifact (``--out BENCH_DECODE.json``):
   dispatch→fetch overlap, prefill/decode compile counts. The serving
   arms also land in their own artifact via ``--serve-out
   BENCH_SERVE.json``,
+- ``{"mode": "serving_spec", ...}`` (``--spec``) — speculative
+  draft-and-verify decode vs the unspeculated oracle on a
+  shared-prefix workload: accept rate, realized tokens/step, the
+  per-token spec/plain ITL ratio, token identity, and the
+  compile-counter pins (one draft + one verify program), with draft
+  params delivered by a real 2-shard parameter-server group,
 - ``{"mode": "fleet_*", ...}`` (``--fleet`` → ``--fleet-out
   BENCH_FLEET.json``) — the replicated fleet: routed-vs-bare overhead
   with token-identity proof, N-replica session-affinity throughput,
@@ -568,6 +574,147 @@ def bench_prefix(compiled, max_slots: int, prompt_len: int,
     }
 
 
+def bench_spec(compiled, max_slots: int, prompt_len: int,
+               new_tokens: int, *, sessions: int = 4, turns: int = 3,
+               gamma: int = 3, refresh_every: int = 8) -> dict:
+    """Speculative-decoding arm (``--spec``): draft-and-verify decode on
+    the paged engine, measured against the unspeculated oracle on the
+    SAME shared-prefix workload.
+
+    The draft model's params are delivered by a real 2-shard parameter
+    server group over sockets (``ShardedParameterClient``, version-gated
+    pulls bounded by ``refresh_every``) — the PS-delivered-draft bridge,
+    exercised end-to-end rather than faked. At bench scale no distilled
+    draft checkpoint exists, so the delivered draft carries the target's
+    own weights: the committed ``spec_accept_rate`` is the MECHANICAL
+    ceiling (a same-weights draft must accept ~everything; the gate
+    floor catches draft-cache/rollback breakage, which shows up as
+    silently sunk acceptance, not as wrong tokens). Self-draft
+    acceptance on this untrained bench model is measured separately in
+    PROFILE.md §22 — it needs a trained target to clear the floor.
+
+    Committed claims: ``token_identical`` (spec streams == oracle
+    streams, request-for-request — identity is correctness, equal-rule
+    in the gate), ``spec_accept_rate`` (floor 0.5), ``tokens_per_step``
+    (floor 1.3 — the whole point of speculation), ``spec_itl_ratio``
+    (spec mean ITL / plain mean ITL, ceiling 1.0 — speculation must not
+    trade the tail away), and the compile counters (exactly one draft +
+    one verify program after warmup).
+    """
+    import numpy as np
+
+    from elephas_tpu.parameter import ShardGroup
+    from elephas_tpu.serving import DraftModelSource, InferenceEngine
+
+    m = compiled.module
+    vocab = m.vocab_size
+    block = max(2, prompt_len // 4)
+    # The speculative pool's virtual row extends ``gamma`` columns past
+    # max_len (rounded up to a block); the draft model's pos_embed table
+    # must cover it, and pos_embed is sized by max_seq_len — so the spec
+    # arm builds its own model with that headroom rather than stretching
+    # the shared bench model (which would resize every other arm's
+    # params).
+    compiled = build_model(
+        vocab, m.d_model, m.num_heads, m.num_layers,
+        max_seq=prompt_len + new_tokens + 1 + gamma + block,
+    )
+    sys_prompt = np.random.default_rng(9).integers(
+        1, vocab, 2 * block).tolist()
+
+    def run(group=None):
+        spec = group is not None
+        kw = {}
+        if spec:
+            kw.update(
+                speculative=True, gamma=gamma,
+                draft_source=DraftModelSource(
+                    compiled.module, group.client(),
+                    refresh_every=refresh_every,
+                ),
+            )
+        eng = InferenceEngine(
+            compiled,
+            max_slots=max_slots,
+            max_prompt_len=prompt_len,
+            max_len=prompt_len + new_tokens + 1,
+            queue_depth=sessions * turns + 2,
+            pipeline=True,
+            paged=True,
+            kv_block_size=block,
+            # Model draft sources require prefix_cache=False (a
+            # prefix-matched admission would leave the draft cache
+            # cold); the oracle matches so the arms differ ONLY in
+            # speculation. "Shared prefix" stays a workload shape.
+            prefix_cache=False,
+            **kw,
+        )
+        eng.result(eng.submit([1] * prompt_len, max_new_tokens=2))
+        eng.metrics.reset()
+        rng = np.random.default_rng(13)
+        streams, results = [], []
+        for _turn in range(turns):
+            rids = []
+            for _s in range(sessions):
+                plen = int(rng.integers(
+                    1, prompt_len - len(sys_prompt) + 1))
+                prompt = sys_prompt + rng.integers(1, vocab, plen).tolist()
+                rids.append(eng.submit(prompt, max_new_tokens=new_tokens))
+            for r in rids:
+                res = eng.result(r, timeout_s=120.0)
+                results.append(res)
+                streams.append(list(res.tokens))
+        st = eng.stats()
+        source = eng.spec.source if spec else None
+        return streams, results, st, source
+
+    group = ShardGroup(compiled.params, 2, mode="socket")
+    group.start()
+    try:
+        spec_streams, spec_results, spec_st, source = run(group)
+    finally:
+        group.stop()
+    oracle_streams, oracle_results, plain_st, _ = run(None)
+    token_identical = spec_streams == oracle_streams
+    # ITL histograms record per-STEP latency (one verify window is one
+    # step emitting up to gamma+1 tokens — that's what tokens_per_step
+    # disambiguates), so the committed ratio is per emitted TOKEN:
+    # spec step cost amortized over its tokens/step, against the plain
+    # engine's one-token steps. Below 1.0 means speculation emits
+    # tokens faster than plain decode, the claim the gate holds.
+    tps = spec_st["spec_tokens_per_step"]
+    spec_itl_ratio = (
+        (spec_st["itl_s_avg"] / tps) / plain_st["itl_s_avg"]
+        if plain_st["itl_s_avg"] and tps else None)
+    return {
+        "mode": "serving_spec",
+        "pipeline": True,
+        "paged": True,
+        "max_slots": max_slots,
+        "requests": sessions * turns,
+        "gamma": gamma,
+        "draft_source": "model",
+        "draft_refresh_every": refresh_every,
+        "draft_pulls": source.pulls,
+        "spec_windows": spec_st["spec_windows"],
+        "spec_accept_rate": spec_st["spec_accept_rate"],
+        "tokens_per_step": spec_st["spec_tokens_per_step"],
+        "itl_s_p50_spec": spec_st["itl_s_p50"],
+        "itl_s_p99_spec": spec_st["itl_s_p99"],
+        "itl_s_p50_plain": plain_st["itl_s_p50"],
+        "itl_s_p99_plain": plain_st["itl_s_p99"],
+        "spec_itl_ratio": spec_itl_ratio,
+        "token_identical": token_identical,
+        "draft_traces": spec_st["draft_traces"],
+        "verify_traces": spec_st["verify_traces"],
+        "draft_prefill_traces": spec_st["draft_prefill_traces"],
+        "decode_traces_spec": spec_st["decode_traces"],
+        "all_completed": all(
+            r.status == "completed"
+            for r in spec_results + oracle_results),
+    }
+
+
 # -- fleet arms (--fleet → BENCH_FLEET.json) ---------------------------------
 
 
@@ -949,6 +1096,15 @@ def main(argv=None) -> list:
                              "multi-turn workload, paged-vs-contiguous "
                              "token identity, and the chunked-vs-"
                              "unchunked prefill ITL p99 tail")
+    parser.add_argument("--spec", action="store_true",
+                        help="run the speculative-decoding arm: draft-"
+                             "and-verify vs the unspeculated oracle on "
+                             "the shared-prefix workload — accept rate, "
+                             "tokens/step, ITL ratio, token identity, "
+                             "compile-counter pins; draft params "
+                             "delivered by a real 2-shard PS group")
+    parser.add_argument("--gamma", type=int, default=3,
+                        help="draft window length for the --spec arm")
     parser.add_argument("--fleet", action="store_true",
                         help="run the replicated-fleet arms: routed-vs-"
                              "bare overhead + token identity, N-replica "
@@ -1027,6 +1183,14 @@ def main(argv=None) -> list:
     if args.prefix:
         rec = bench_prefix(
             compiled, args.serving_slots, args.prompt_len, args.new,
+        )
+        serving_records.append(rec)
+        records.append(rec)
+        print(json.dumps(rec))
+    if args.spec:
+        rec = bench_spec(
+            compiled, args.serving_slots, args.prompt_len, args.new,
+            gamma=args.gamma,
         )
         serving_records.append(rec)
         records.append(rec)

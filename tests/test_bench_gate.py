@@ -489,3 +489,45 @@ def test_prefix_rules_gate_hit_rate_identity_and_itl_tail():
               "tokens_per_sec": 100.0, "all_completed": True}]
     plain_by = _checks_by_metric(bg.compare(plain, plain, "serve"))
     assert ("serving/True", "prefix_hit_rate") not in plain_by
+
+
+def test_spec_rules_gate_accept_identity_and_itl_ratio():
+    """The lm_bench --spec row: token identity vs the unspeculated
+    oracle is exact (the speculative contract), the accept rate is an
+    absolute floor at 0.5 (the bench's same-weights PS-delivered draft
+    accepts ~everything — sinking under the floor means the draft
+    cache/rollback mechanics broke, which never corrupts tokens, only
+    acceptance), tokens_per_step is an absolute floor at 1.3 (the
+    speedup claim itself), and the per-token spec/plain ITL ratio is an
+    absolute ceiling at 1.0 — a fresh ratio worse than baseline but
+    still under 1.0 passes (the claim is 'speculation never slows
+    emission', not a baseline diff)."""
+    base = [{"mode": "serving_spec", "pipeline": True, "gamma": 3,
+             "spec_accept_rate": 1.0, "tokens_per_step": 3.9,
+             "spec_itl_ratio": 0.32, "token_identical": True,
+             "all_completed": True}]
+    drifted = bg.compare(base, [dict(base[0], spec_accept_rate=0.6,
+                                     tokens_per_step=1.4,
+                                     spec_itl_ratio=0.95)], "serve")
+    assert all(c["ok"] for c in drifted)
+    broken = bg.compare(base, [dict(base[0], spec_accept_rate=0.3,
+                                    tokens_per_step=1.1,
+                                    spec_itl_ratio=1.2,
+                                    token_identical=False)], "serve")
+    failed = sorted(c["metric"] for c in broken if not c["ok"])
+    assert failed == ["spec_accept_rate", "spec_itl_ratio",
+                      "token_identical", "tokens_per_step"]
+    by = _checks_by_metric(bg.compare(base, base, "serve"))
+    key = "serving_spec/True"
+    assert (key, "spec_accept_rate") in by
+    assert (key, "tokens_per_step") in by
+    assert (key, "spec_itl_ratio") in by
+    # Rows without the spec metrics (the plain serving arms) are
+    # untouched by the new rules — tokens_per_step in particular only
+    # exists on the spec row, so its 1.3 floor cannot leak onto the
+    # one-token-per-step baseline arms.
+    plain = [{"mode": "serving", "pipeline": True,
+              "tokens_per_sec": 100.0, "all_completed": True}]
+    plain_by = _checks_by_metric(bg.compare(plain, plain, "serve"))
+    assert ("serving/True", "spec_accept_rate") not in plain_by
+    assert ("serving/True", "tokens_per_step") not in plain_by

@@ -564,6 +564,35 @@ def test_fleet_top_renders_kv_column():
     assert "KV" in board
 
 
+def test_fleet_top_renders_spec_column():
+    """A speculating engine's /load signals carry the draft accept rate
+    and realized tokens/step; the SPEC column renders them as
+    rate%(tokens/step) — and '-' for engines not speculating (the
+    signals are absent from their snapshots by construction)."""
+    import scripts.fleet_top as fleet_top
+
+    bodies = _fake_bodies()
+    bodies["/load"] = json.dumps({
+        "score": 0.2,
+        "signals": {"spec_accept_rate": 0.75,
+                    "spec_tokens_per_step": 2.5},
+    }).encode()
+    agg = FleetAggregator(clock=lambda: 0.0,
+                          fetch=_fake_fetch_factory({
+                              "http://a": bodies,
+                              "http://b": _fake_bodies(),  # not speculating
+                          }))
+    agg.add("http://a", name="a")
+    agg.add("http://b", name="b")
+    agg.poll(now=0.0)
+    board = fleet_top.render(agg.snapshot(now=0.0))
+    row_a = next(ln for ln in board.splitlines() if ln.startswith("a "))
+    assert "75%(2.5)" in row_a
+    row_b = next(ln for ln in board.splitlines() if ln.startswith("b "))
+    assert row_b.split()[-3] == "-"  # SPEC sits between KV and DISK
+    assert "SPEC" in board
+
+
 # --------------------------------------------------------------------------
 # /replicas federation (serving-fleet router roster)
 # --------------------------------------------------------------------------
