@@ -97,6 +97,12 @@ from elephas_tpu.obs.canary import (  # noqa: F401
     CanaryDriver,
     PSCanary,
 )
+from elephas_tpu.obs.tenancy import (  # noqa: F401
+    DEFAULT_TENANT,
+    CostLedger,
+    merge_tenant_docs,
+    tenant_rules,
+)
 from elephas_tpu.obs.store import (  # noqa: F401
     RECORD_KINDS,
     TelemetryStore,

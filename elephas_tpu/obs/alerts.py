@@ -50,6 +50,8 @@ RULE_NAMES = (
     "canary_probe_failures",
     "staleness_rejection_rate",
     "tune_trial_stalled",
+    "tenant_burn_high",
+    "noisy_neighbor",
 )
 
 _PREDICATES = (">", "<")

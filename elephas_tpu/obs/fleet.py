@@ -471,7 +471,7 @@ class FleetAggregator:
         # serve them, and their absence must not fail the whole poll —
         # each is fetched in its own tolerant attempt.
         for route in ("/load", "/slo", "/replicas", "/incidents",
-                      "/trials"):
+                      "/trials", "/tenants"):
             try:
                 scrape[route[1:]] = json.loads(
                     self.fetch(f"{entry.url}{route}", self.timeout))
@@ -543,6 +543,17 @@ class FleetAggregator:
         per_trials = {e.name: e.scrape["trials"]
                       for e in entries
                       if e.scrape.get("trials", {}).get("trials")}
+        # Per-tenant cost ledgers (/tenants): only procs with a live
+        # ledger contribute (a non-empty tenant table). Counters union
+        # tenant-wise across replicas — a tenant's fleet bill is the
+        # sum of its per-replica bills — via the same merge the
+        # router's own /tenants route uses.
+        per_tenants = {e.name: e.scrape["tenants"]
+                       for e in entries
+                       if e.scrape.get("tenants", {}).get("tenants")}
+        from elephas_tpu.obs.tenancy import merge_tenant_docs
+        merged_tenants = merge_tenant_docs(
+            [per_tenants[k] for k in sorted(per_tenants)])
         status_counts: Dict[str, int] = {}
         for e in entries:
             status_counts[e.status] = status_counts.get(e.status, 0) + 1
@@ -560,4 +571,6 @@ class FleetAggregator:
             "replicas": per_replicas,
             "incidents": per_incidents,
             "trials": per_trials,
+            "per_tenants": per_tenants,
+            "tenants": merged_tenants,
         }

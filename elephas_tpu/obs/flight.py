@@ -90,6 +90,12 @@ KINDS = (
     # failed to produce params (e.g. PS pull error) and the decoder
     # degraded to plain decode for that window instead of erroring
     "spec_fallback",
+    # per-tenant cost attribution (obs/tenancy.py): a tenant's
+    # multi-window goodput burn crossed budget parity, or one tenant
+    # holds most of the KV pool's integrated block-seconds while other
+    # tenants are also paying for blocks
+    "tenant_burn",
+    "noisy_neighbor",
 )
 
 

@@ -93,6 +93,7 @@ ROUTES = (
     "/replicas",
     "/incidents",
     "/trials",
+    "/tenants",
 )
 
 
@@ -152,6 +153,10 @@ class OpsServer:
     trials_fn: the ``/trials`` payload (a ``TuneRunner.trials_snapshot``
         — per-trial rung/status/loss cards, rung counts, the search
         digest); empty search when unset.
+    tenants_fn: the ``/tenants`` payload (a ``CostLedger.snapshot`` —
+        per-tenant token/queue/block-second costs, goodput, and the
+        tenancy alert state; routers serve the tenant-wise union over
+        their replicas); empty ledger when unset.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
@@ -170,7 +175,8 @@ class OpsServer:
                  canary_fn: Optional[Callable[[], Dict]] = None,
                  replicas_fn: Optional[Callable[[], Dict]] = None,
                  incidents_fn: Optional[Callable[[], Dict]] = None,
-                 trials_fn: Optional[Callable[[], Dict]] = None):
+                 trials_fn: Optional[Callable[[], Dict]] = None,
+                 tenants_fn: Optional[Callable[[], Dict]] = None):
         self._requested_port = port
         self.host = host if host is not None else _default_bind_host()
         self._registry = registry
@@ -193,6 +199,7 @@ class OpsServer:
         self._replicas_fn = replicas_fn
         self._incidents_fn = incidents_fn
         self._trials_fn = trials_fn
+        self._tenants_fn = tenants_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_wall = None
@@ -219,6 +226,7 @@ class OpsServer:
         self._add_route("/replicas", self._h_replicas)
         self._add_route("/incidents", self._h_incidents)
         self._add_route("/trials", self._h_trials)
+        self._add_route("/tenants", self._h_tenants)
 
     def _add_route(self, path: str, handler: Callable) -> None:
         self._routes[path] = handler
@@ -383,6 +391,13 @@ class OpsServer:
             return 200, self._trials_fn()
         return 200, {"counts": {}, "trials": {}, "best": None,
                      "search_digest": None, "epochs_spent": 0}
+
+    def _h_tenants(self, query):
+        if self._tenants_fn is not None:
+            return 200, self._tenants_fn()
+        return 200, {"tenants": {}, "totals": {}, "kv_share": {},
+                     "alerts": {"active": [], "fired": [],
+                                "fired_kinds": []}}
 
     def start(self) -> "OpsServer":
         if self._httpd is not None:

@@ -47,6 +47,21 @@ def default_latency_buckets() -> Tuple[float, ...]:
     return tuple(1e-5 * 2 ** i for i in range(24))
 
 
+_trace_mod = None
+
+
+def _current_trace_id() -> Optional[str]:
+    """Active trace id, or None. Lazily binds ``obs.trace`` so the
+    registry (imported first by ``obs/__init__``) never participates in
+    an import cycle; only exemplar-enabled histograms pay the call."""
+    global _trace_mod
+    if _trace_mod is None:
+        from elephas_tpu.obs import trace as _t
+        _trace_mod = _t
+    ctx = _trace_mod.current_context()
+    return ctx.trace_id if ctx is not None else None
+
+
 def _escape_label_value(v: str) -> str:
     """Prometheus exposition escaping: backslash, quote, newline."""
     return (v.replace("\\", r"\\").replace('"', r"\"")
@@ -113,13 +128,23 @@ class Histogram:
     (bisect over ~24 bounds); nothing per-sample is stored beyond
     count/sum/min/max, so a million decode steps cost the same memory
     as ten.
+
+    ``exemplars=True`` additionally latches the *active trace id* per
+    bucket on every observe (last-writer-wins, one string slot per
+    bucket — still O(buckets) memory): a p99 spike in the exposition
+    joins directly to the span tree of a request that actually landed
+    in that bucket, via ``exemplar_ids()`` and
+    ``scripts/trace_report.py``. Off by default; recording sites that
+    run under per-request trace context (``ServingMetrics``'s ITL
+    mirror) opt in.
     """
 
     __slots__ = ("name", "help", "labels", "bounds", "counts", "count",
-                 "sum", "min", "max")
+                 "sum", "min", "max", "exemplars")
 
     def __init__(self, name: str, help: str = "",
-                 buckets: Optional[Iterable[float]] = None):
+                 buckets: Optional[Iterable[float]] = None,
+                 exemplars: bool = False):
         self.name = name
         self.help = help
         self.labels = None  # set by the owning Family, if any
@@ -133,6 +158,8 @@ class Histogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        self.exemplars: Optional[List[Optional[str]]] = \
+            [None] * (len(bounds) + 1) if exemplars else None
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -152,6 +179,23 @@ class Histogram:
             else:
                 lo = mid + 1
         self.counts[lo] += 1
+        if self.exemplars is not None:
+            trace_id = _current_trace_id()
+            if trace_id is not None:
+                self.exemplars[lo] = trace_id
+
+    def exemplar_ids(self) -> Dict[str, str]:
+        """``le-bound → trace id`` for every bucket that latched one
+        (the join key into a trace dump); empty when disabled."""
+        if self.exemplars is None:
+            return {}
+        out: Dict[str, str] = {}
+        for i, tid in enumerate(self.exemplars):
+            if tid is not None:
+                le = (f"{self.bounds[i]:g}" if i < len(self.bounds)
+                      else "+Inf")
+                out[le] = tid
+        return out
 
     @property
     def mean(self) -> Optional[float]:
@@ -297,9 +341,11 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "",
                   buckets: Optional[Iterable[float]] = None,
-                  labelnames: Tuple[str, ...] = ()):
+                  labelnames: Tuple[str, ...] = (),
+                  exemplars: bool = False):
         return self._get_or_create(Histogram, name, help,
-                                   labelnames=labelnames, buckets=buckets)
+                                   labelnames=labelnames, buckets=buckets,
+                                   exemplars=exemplars)
 
     def instruments(self) -> List[object]:
         with self._lock:
@@ -370,6 +416,29 @@ class MetricsRegistry:
                         out[f"{child.name}_{pk}{suffix}"] = v
             else:
                 out[f"{child.name}{suffix}"] = child.value
+
+        for inst in self.instruments():
+            if isinstance(inst, Family):
+                for child in inst.children():
+                    emit(child)
+            else:
+                emit(inst)
+        return out
+
+    def exemplars(self) -> Dict[str, Dict[str, str]]:
+        """Every latched histogram exemplar: snapshot-style key
+        (``name`` or ``name{labels}``) → ``{le: trace_id}``. Served
+        out-of-band from the text exposition (the 0.0.4 format has no
+        exemplar syntax and ``obs.fleet.parse_prometheus_text`` must
+        keep round-tripping ``expose_text`` unchanged)."""
+        out: Dict[str, Dict[str, str]] = {}
+
+        def emit(child):
+            if isinstance(child, Histogram):
+                ids = child.exemplar_ids()
+                if ids:
+                    out[f"{child.name}"
+                        f"{_render_labels(child.labels or {})}"] = ids
 
         for inst in self.instruments():
             if isinstance(inst, Family):

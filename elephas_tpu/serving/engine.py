@@ -277,6 +277,15 @@ class InferenceEngine:
         # probes excluded — see _publish).
         self.load = obs.LoadTracker(clock=clock)
         self.slo = obs.GoodputLedger(clock=clock)
+        # Per-tenant cost attribution: the scheduler bills queue
+        # seconds, prefill/decode tokens, spec windows and terminal
+        # statuses per request tenant; the paged pool integrates KV
+        # block-seconds per owning slot. Canary-blind goodput rides
+        # _publish (mirroring self.slo), so per-tenant burn matches
+        # the fleet ledger's exclusions.
+        self.costs = obs.CostLedger(clock=clock)
+        if paged:
+            self.pool.attach_cost_ledger(self.costs, clock)
         self.scheduler = ContinuousBatchingScheduler(
             self.pool,
             self.queue,
@@ -289,6 +298,7 @@ class InferenceEngine:
             pipeline=pipeline,
             tracer=self.tracer,
             load=self.load,
+            costs=self.costs,
             chunk_prefill_fn=self._chunk_prefill if paged else None,
             prefill_chunk=self.prefill_chunk,
             prefill_chunks_per_step=prefill_chunks_per_step,
@@ -710,6 +720,7 @@ class InferenceEngine:
         stop_token: Optional[int] = "default",
         timeout_s: Optional[float] = None,
         canary: bool = False,
+        tenant: Optional[str] = None,
     ) -> int:
         """Enqueue a request; returns its id. Raises ``QueueFull`` (with
         ``.retry_after``) when admission control rejects it.
@@ -718,7 +729,15 @@ class InferenceEngine:
         the identical admission/prefill/decode path but its finished
         result is excluded from the goodput ledger (the tag must land
         before the queue submit — a serve thread can finish the probe
-        before this method returns)."""
+        before this method returns).
+
+        ``tenant`` names the account billed for this request's tokens,
+        queue seconds and KV block-seconds in the engine's
+        ``CostLedger`` (untagged requests bill to ``"default"``). The
+        tag rides the request object itself, so it survives fleet
+        requeue-on-death replays unchanged. The request also roots (or
+        adopts) a trace context here: the scheduler re-activates it at
+        finish so histogram exemplars latch THIS request's trace id."""
         prompt = [int(t) for t in prompt]  # host-ok: caller-supplied ints
         if not 1 <= len(prompt) <= self.max_prompt_len:
             raise ValueError(
@@ -736,6 +755,11 @@ class InferenceEngine:
             timeout_s=timeout_s,
             submitted_at=now,
             deadline=None if timeout_s is None else now + timeout_s,
+            tenant=tenant,
+            # Adopt the caller's distributed trace context (a router
+            # hop) or root a fresh one — either way every span and
+            # exemplar this request produces carries one trace id.
+            ctx=obs.current_context() or obs.new_context(),
         )
         if canary:
             with self._cond:
@@ -747,15 +771,18 @@ class InferenceEngine:
                 with self._cond:
                     self._canary_ids.discard(req.req_id)
             self.metrics.record_reject()
+            self.costs.record_reject(tenant)
             obs.default_flight_recorder().note(
                 "backpressure_reject", "warn", req_id=req.req_id,
                 queue_depth=len(self.queue), retry_after_s=err.retry_after,
             )
             raise
         self.metrics.record_submit()
+        self.costs.record_submit(tenant)
         self.tracer.instant(
             "submit", at=now, track=f"req:{req.req_id}",
             req_id=req.req_id, prompt_tokens=len(prompt),
+            tenant=tenant or obs.DEFAULT_TENANT,
         )
         return req.req_id
 
@@ -787,6 +814,9 @@ class InferenceEngine:
             self._cond.notify_all()
         for r in real:
             self.slo.record(r)
+            # Same canary-blindness as the fleet ledger: per-tenant
+            # goodput/burn must agree with the aggregate SLO view.
+            self.costs.record_goodput(r)
 
     def halt(self) -> None:
         """Simulate process death for chaos harnesses: after any
@@ -889,7 +919,16 @@ class InferenceEngine:
             out.update(self.pool.prefix_stats())
         if self.spec is not None:
             out.update(self.spec.stats())
+        if len(self.costs.tenants()) > 0:
+            out["tenancy"] = self.costs.snapshot()
         return out
+
+    def _tenants_doc(self) -> dict:
+        """``/tenants``: evaluate the per-tenant alert rules (burn,
+        noisy-neighbor KV share) against the ledger's synthetic metric
+        view, then snapshot — rows, totals, kv_share, alerts."""
+        self.costs.evaluate_alerts(self.clock())
+        return self.costs.snapshot()
 
     def mount_ops(self, port: int = 0, host: Optional[str] = None,
                   store_dir: Optional[str] = None):
@@ -900,7 +939,8 @@ class InferenceEngine:
         ``ServingMetrics`` feeds), plus the saturation/goodput plane:
         ``/load`` (EWMA load score), ``/slo`` (windowed goodput +
         burn), ``/canary`` (blackbox probe SLIs when a driver is
-        attached). Loopback-bound by default; port 0 picks a free one
+        attached), ``/tenants`` (per-tenant cost ledger + burn/KV-share
+        alerts). Loopback-bound by default; port 0 picks a free one
         (read ``engine.ops.port``). Idempotent.
 
         ``store_dir`` additionally mounts the durable telemetry journal
@@ -946,6 +986,7 @@ class InferenceEngine:
             load_fn=self.load.snapshot,
             slo_fn=self.slo.snapshot,
             canary_fn=self._canary_doc,
+            tenants_fn=self._tenants_doc,
             incidents_fn=(self.store.doc if self.store is not None
                           else None),
         ).start()

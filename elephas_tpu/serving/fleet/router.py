@@ -192,8 +192,14 @@ class Router:
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                *, session: Optional[str] = None,
                timeout_s: Optional[float] = None,
-               canary: bool = False) -> int:
+               canary: bool = False,
+               tenant: Optional[str] = None) -> int:
         """Route one request; returns a router-scoped request id.
+
+        ``tenant`` names the account billed for the request in the
+        assigned replica's cost ledger. It rides the assignment's
+        replay kwargs, so a requeue-on-death resubmits with the SAME
+        tag — attribution survives mid-flight replica kills.
 
         Raises ``FleetUnavailable`` when no replica is serving, or the
         last replica's ``QueueFull`` when every one rejected admission.
@@ -209,7 +215,7 @@ class Router:
             try:
                 engine_rid = candidate.engine.submit(
                     prompt, max_new_tokens=max_new_tokens,
-                    timeout_s=timeout_s, canary=canary)
+                    timeout_s=timeout_s, canary=canary, tenant=tenant)
             except QueueFull as err:
                 last_full = err
                 continue
@@ -237,7 +243,7 @@ class Router:
         asg = _Assignment(
             router_id, list(prompt),
             {"max_new_tokens": max_new_tokens, "timeout_s": timeout_s,
-             "canary": canary},
+             "canary": canary, "tenant": tenant},
             session, canary, rep.replica_id, engine_rid,
             t_router, self.clock())
         with self._lock:
@@ -307,6 +313,13 @@ class Router:
         rep.note_dispatch()
         self.requeues += 1
         self._m_requeue.inc()
+        # The replay carried the original tenant tag (it lives in
+        # asg.kwargs); charge the requeue itself to that tenant on the
+        # RECEIVING replica's ledger, where the rest of the request's
+        # costs will now accrue.
+        costs = getattr(rep.engine, "costs", None)
+        if costs is not None:
+            costs.record_requeue(asg.kwargs.get("tenant"))
         with self._lock:
             asg.replica_id = rep.replica_id
             asg.engine_rid = engine_rid
@@ -430,8 +443,24 @@ class Router:
             },
             slo_fn=self.slo.snapshot,
             replicas_fn=self.replicas_doc,
+            tenants_fn=self._tenants_doc,
         ).start()
         return self.ops
+
+    def _tenants_doc(self) -> Dict[str, Any]:
+        """Fleet-wide ``/tenants``: tenant-wise union of every serving
+        replica's cost ledger (counters summed, goodput ratio = worst
+        across replicas, burn = worst) — the same merge the
+        ``FleetAggregator`` applies to scraped per-process docs."""
+        from elephas_tpu.obs.tenancy import merge_tenant_docs
+
+        docs = []
+        for rep in self.replica_set.serving():
+            costs = getattr(rep.engine, "costs", None)
+            if costs is not None and costs.tenants():
+                costs.evaluate_alerts(self.clock())
+                docs.append(costs.snapshot())
+        return merge_tenant_docs(docs)
 
     def unmount_ops(self) -> None:
         if self.ops is not None:

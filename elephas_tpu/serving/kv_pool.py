@@ -451,6 +451,19 @@ class PagedKVPool(KVCachePool):
         self._mirror = None
         self._pushed_hits = 0
         self._pushed_lookups = 0
+        # Per-tenant cost attribution (obs/tenancy.py): the scheduler
+        # names each slot's owning tenant before binding any blocks,
+        # and the pool integrates block-seconds (elapsed wall seconds
+        # x resident block count) into the attached CostLedger at
+        # every block-count change and at release — each integration
+        # window therefore has a constant block count, so occupancy
+        # bills exactly from the first prefix-bound instant to the
+        # final decref. Unattached (no ledger), all of this is dead
+        # dict lookups — the ≤2% overhead ceiling stays intact.
+        self._costs = None
+        self._cost_clock = None
+        self._owner: Dict[int, Optional[str]] = {}
+        self._billed_at: Dict[int, float] = {}
 
     # -- block accounting ----------------------------------------------------
 
@@ -501,6 +514,45 @@ class PagedKVPool(KVCachePool):
         self._incref(block)
         return block
 
+    # -- per-tenant occupancy billing ----------------------------------------
+
+    def attach_cost_ledger(self, ledger, clock=None) -> None:
+        """Wire a ``CostLedger`` so slot block occupancy bills to each
+        slot's owning tenant as KV block-seconds. ``clock`` defaults to
+        the ledger's own clock (the engine injects its clock so the
+        fake clocks benchmarks and tests drive stay deterministic)."""
+        self._costs = ledger
+        self._cost_clock = clock if clock is not None else ledger.clock
+
+    def set_slot_owner(self, slot: int, tenant: Optional[str]) -> None:
+        """Name the tenant billed for ``slot``'s block occupancy from
+        this instant on. The scheduler calls this BEFORE
+        ``admit_prefix`` so prefix-bound blocks bill from their first
+        resident moment, not from first decode."""
+        self._owner[slot] = tenant
+        if self._cost_clock is not None:
+            self._billed_at[slot] = self._cost_clock()
+
+    def _bill_slot(self, slot: int, *, cow: bool = False) -> None:
+        """Integrate ``slot``'s occupancy since its last bill into the
+        attached ledger: elapsed seconds x blocks currently resident.
+        Called before every block-count change (``ensure_cols`` runs it
+        each decode step, making it the steady-state integrator) and on
+        release (the closing bill). ``cow=True`` additionally counts a
+        copy-on-write block copy against the owning tenant."""
+        if self._costs is None:
+            return
+        last = self._billed_at.get(slot)
+        if last is None:
+            return  # slot never owned: nothing to attribute
+        now = self._cost_clock()
+        blocks = int((self.table.rows[slot] >= 0).sum())  # host-ok: numpy table
+        seconds = (now - last) * blocks
+        self._billed_at[slot] = now
+        if seconds > 0.0 or cow:
+            self._costs.record_block_seconds(
+                self._owner.get(slot), seconds, cow=cow)
+
     def assert_block_invariants(self) -> None:
         """Free-list/refcount conservation — every block is either free
         (refcount 0) or accounted for by exactly its refcount many
@@ -538,6 +590,7 @@ class PagedKVPool(KVCachePool):
         the matched token count — prefill resumes at that column."""
         if self.prefix is None:
             return 0
+        self._bill_slot(slot)  # close the zero-block window pre-bind
         matched, blocks = self.prefix.match(prompt)
         for i, b in enumerate(blocks):
             self._incref(b)
@@ -568,6 +621,7 @@ class PagedKVPool(KVCachePool):
                 f"slot {slot} needs column {upto - 1} but rows are "
                 f"{self.virtual_len} columns"
             )
+        self._bill_slot(slot)  # per-decode-step occupancy integration
         row = self.table.rows[slot]
         for i in range(-(-upto // self.block_size)):
             if row[i] < 0:
@@ -592,6 +646,9 @@ class PagedKVPool(KVCachePool):
             raise ValueError(f"slot {slot} column {col} is unallocated")
         if self._ref[block] == 1:
             return block
+        # The copy is work the FORKING slot's tenant caused; bill the
+        # elapsed window at the old count and count the COW event.
+        self._bill_slot(slot, cow=True)
         fresh = self._alloc_block()
         self.swap(_copy_block(self.cache, jnp.int32(block),
                               jnp.int32(fresh)))
@@ -615,6 +672,12 @@ class PagedKVPool(KVCachePool):
             if b >= 0:
                 self._incref(int(b))  # host-ok: numpy table
                 self.table.set(child, i, int(b))  # host-ok: numpy table
+        # A fork's occupancy is the forking tenant's doing: the child
+        # inherits the parent's owner and starts its own billing window
+        # at full block count (every aliased block bills twice — once
+        # per holder — matching the refcounts it actually pins).
+        if parent in self._owner:
+            self.set_slot_owner(child, self._owner[parent])
         return child
 
     def release(self, slot: int,
@@ -633,6 +696,9 @@ class PagedKVPool(KVCachePool):
             raise ValueError(f"slot {slot} is already free")
         if not 0 <= slot < self.max_slots:
             raise ValueError(f"slot {slot} out of range [0, {self.max_slots})")
+        self._bill_slot(slot)  # closing bill: occupancy up to release
+        self._owner.pop(slot, None)
+        self._billed_at.pop(slot, None)
         row = self.table.rows[slot]
         if tokens is not None and self.prefix is not None:
             backed = int((row >= 0).sum())  # host-ok: numpy table

@@ -123,9 +123,15 @@ class ServingMetrics:
             if hist is None:
                 try:
                     from elephas_tpu import obs
+                    # exemplars=True: each observe latches the request's
+                    # active trace id on its bucket, so a p99 spike in
+                    # the exposition joins to the exact span tree in
+                    # trace_report (the record runs inside the request
+                    # span the scheduler opened).
                     hist = obs.default_registry().histogram(
                         "serving_itl_seconds",
                         help="per-request mean inter-token latency",
+                        exemplars=True,
                     )
                 except Exception:
                     hist = False

@@ -73,6 +73,15 @@ class Request:
     timeout_s: Optional[float] = None
     submitted_at: float = 0.0
     deadline: Optional[float] = None  # absolute, from submitted_at
+    # Cost attribution: who pays for this request's tokens, queue
+    # seconds, and KV block-seconds. None bills the "default" tenant.
+    # The tag rides the request object end to end — through the
+    # scheduler, spec harvests, and the router's requeue-on-death.
+    tenant: Optional[str] = None
+    # The trace context rooted at submit: finish-side observability
+    # (request spans, the ITL histogram's exemplar latch) re-activates
+    # it so /metrics joins to this request's span tree.
+    ctx: Any = None
 
 
 @dataclass
@@ -93,6 +102,9 @@ class GenerationResult:
     # would otherwise silently under-report ITL). The prefill-produced
     # first token is excluded — it cost no decode step.
     tokens_per_step: Optional[float] = None
+    # The tenant billed for this request (attribution survives into the
+    # result so the engine's publish path can drive per-tenant goodput).
+    tenant: Optional[str] = None
 
 
 class RequestQueue:
@@ -236,6 +248,7 @@ class ContinuousBatchingScheduler:
         prefill_chunks_per_step: Optional[int] = None,
         spec_decode_fn: Optional[Callable] = None,
         gamma: Optional[int] = None,
+        costs=None,
     ):
         self.pool = pool
         self.queue = queue
@@ -257,6 +270,11 @@ class ContinuousBatchingScheduler:
         self.metrics = metrics
         self.clock = clock
         self.pipeline = pipeline
+        # Per-tenant cost attribution (obs.tenancy.CostLedger, engine-
+        # owned): every token emission, queue residency, and terminal
+        # status bills the request's tenant tag here. None disables
+        # attribution without branching cost elsewhere.
+        self.costs = costs
         # Saturation plane (obs.LoadTracker, engine-owned): fed once per
         # step with the queue/slot/KV signals already in hand here, so
         # the /load route and a future admission router see a score
@@ -327,28 +345,40 @@ class ContinuousBatchingScheduler:
                 (len(entry.tokens) - 1) / entry.steps
                 if entry.steps > 0 else None
             ),
+            tenant=req.tenant,
         )
-        if self.tracer.enabled:
-            now = self.clock()
-            track = f"req:{req.req_id}"
-            if times and times[-1] > entry.admitted_at:
-                self.tracer.record(
-                    "decode", entry.admitted_at, times[-1], track=track,
-                    req_id=req.req_id, tokens=len(entry.tokens),
+        # Finish-side observability runs under the request's own trace
+        # context: the spans below and the ITL histogram's exemplar
+        # latch (ServingMetrics → serving_itl_seconds) both tag this
+        # request's trace id, which is what joins a /metrics bucket to
+        # its span tree.
+        with obs.activate(req.ctx):
+            if self.tracer.enabled:
+                now = self.clock()
+                track = f"req:{req.req_id}"
+                if times and times[-1] > entry.admitted_at:
+                    self.tracer.record(
+                        "decode", entry.admitted_at, times[-1], track=track,
+                        req_id=req.req_id, tokens=len(entry.tokens),
+                    )
+                self.tracer.instant(
+                    "finish", at=now, track=track, req_id=req.req_id,
+                    status=status,
                 )
-            self.tracer.instant(
-                "finish", at=now, track=track, req_id=req.req_id,
-                status=status,
-            )
-            self.tracer.record(
-                "request", req.submitted_at, now, track=track,
-                req_id=req.req_id, status=status, tokens=len(entry.tokens),
-            )
-        self._results.append(result)
-        if self.metrics is not None:
-            self.metrics.record_finish(
-                result, queue_depth=len(self.queue), active=len(self._active)
-            )
+                self.tracer.record(
+                    "request", req.submitted_at, now, track=track,
+                    req_id=req.req_id, status=status,
+                    tokens=len(entry.tokens),
+                    tenant=req.tenant or "default",
+                )
+            self._results.append(result)
+            if self.metrics is not None:
+                self.metrics.record_finish(
+                    result, queue_depth=len(self.queue),
+                    active=len(self._active),
+                )
+        if self.costs is not None:
+            self.costs.record_status(req.tenant, status)
         return result
 
     def _evict_expired(self) -> None:
@@ -374,11 +404,13 @@ class ContinuousBatchingScheduler:
                 where="prefill", tokens=0,
             )
             # Drop the slot's half-written blocks (no chain to publish —
-            # the prompt never finished landing).
+            # the prompt never finished landing). The evicted tenant is
+            # still billed for its block occupancy up to this instant
+            # (the pool integrates on release) and for the eviction.
             self.pool.release(slot)
             result = GenerationResult(
                 req_id=req.req_id, tokens=[], status="timeout",
-                prompt_tokens=len(req.prompt),
+                prompt_tokens=len(req.prompt), tenant=req.tenant,
             )
             if self.tracer.enabled:
                 track = f"req:{req.req_id}"
@@ -389,6 +421,7 @@ class ContinuousBatchingScheduler:
                 self.tracer.record(
                     "request", req.submitted_at, now, track=track,
                     req_id=req.req_id, status="timeout", tokens=0,
+                    tenant=req.tenant or "default",
                 )
             self._results.append(result)
             if self.metrics is not None:
@@ -396,6 +429,8 @@ class ContinuousBatchingScheduler:
                     result, queue_depth=len(self.queue),
                     active=len(self._active),
                 )
+            if self.costs is not None:
+                self.costs.record_status(req.tenant, "timeout")
 
     def _expire_queued(self, req: Request, t_pop: float) -> None:
         """Account a request that expired while still queued — don't
@@ -412,16 +447,23 @@ class ContinuousBatchingScheduler:
         self.tracer.record(
             "request", req.submitted_at, t_pop, track=track,
             req_id=req.req_id, status="timeout", tokens=0,
+            tenant=req.tenant or "default",
         )
         self._results.append(GenerationResult(
             req_id=req.req_id, tokens=[], status="timeout",
-            prompt_tokens=len(req.prompt),
+            prompt_tokens=len(req.prompt), tenant=req.tenant,
         ))
         if self.metrics is not None:
             self.metrics.record_finish(
                 self._results[-1], queue_depth=len(self.queue),
                 active=len(self._active),
             )
+        if self.costs is not None:
+            # The tenant pays for its queue residency even when the
+            # request dies there — queue seconds are a shared-resource
+            # cost whether or not a prefill ever ran.
+            self.costs.record_queue(req.tenant, t_pop - req.submitted_at)
+            self.costs.record_status(req.tenant, "timeout")
 
     def _admit_from_queue(self) -> None:
         import jax.numpy as jnp
@@ -463,6 +505,14 @@ class ContinuousBatchingScheduler:
             )
             entry.admitted_at = self.clock()
             self._active[slot] = entry
+            if self.costs is not None:
+                # Queue residency ends here; the prompt's prefill and
+                # its first emitted token bill now (the contiguous pool
+                # has no prefix cache — nothing is ever discounted).
+                self.costs.record_queue(req.tenant,
+                                        t_pop - req.submitted_at)
+                self.costs.record_prefill(req.tenant, plen)
+                self.costs.record_decode(req.tenant, 1)
             if self.tracer.enabled:
                 self.tracer.record(
                     "queue", req.submitted_at, t_pop, track=track,
@@ -498,7 +548,16 @@ class ContinuousBatchingScheduler:
                 continue
             slot = self.pool.acquire()
             assert slot is not None  # guarded by free_count above
+            # Declare the slot's owner BEFORE the first block binds so
+            # every block-second — including the prefix-bound ones —
+            # bills this tenant from the first instant.
+            if self.costs is not None and \
+                    hasattr(self.pool, "set_slot_owner"):
+                self.pool.set_slot_owner(slot, req.tenant)
             matched = self.pool.admit_prefix(slot, req.prompt)
+            if self.costs is not None:
+                self.costs.record_queue(req.tenant,
+                                        t_pop - req.submitted_at)
             self._prefilling[slot] = _Prefilling(
                 request=req, slot=slot, matched=matched,
                 next_col=matched, t_pop=t_pop,
@@ -553,6 +612,12 @@ class ContinuousBatchingScheduler:
         )
         entry.admitted_at = self.clock()
         self._active[pf.slot] = entry
+        if self.costs is not None:
+            # The whole prompt is on device: bill its prefill (with the
+            # prefix-cache discount visible) and the first emitted token.
+            self.costs.record_prefill(req.tenant, len(req.prompt),
+                                      cached=pf.matched)
+            self.costs.record_decode(req.tenant, 1)
         if self.tracer.enabled:
             track = f"req:{req.req_id}"
             self.tracer.record(
@@ -699,11 +764,19 @@ class ContinuousBatchingScheduler:
             "decode_step", inflight.dispatched_at, now, lanes=len(live),
         )
         emitted = 0
+        # Attribution batched per tenant: one ledger call per tenant per
+        # step, not per token (lanes are few; the lock is not).
+        tenant_tokens: Optional[Dict[Optional[str], int]] = (
+            {} if self.costs is not None else None
+        )
         for (slot, entry), (_, tok) in zip(live, fetched):
             entry.tokens.append(tok)
             entry.token_times.append(now)
             entry.steps += 1
             emitted += 1
+            if tenant_tokens is not None:
+                t = entry.request.tenant
+                tenant_tokens[t] = tenant_tokens.get(t, 0) + 1
             if tok == entry.request.stop_token or \
                     len(entry.tokens) >= entry.budget:
                 self._finish(entry, "completed")
@@ -711,6 +784,9 @@ class ContinuousBatchingScheduler:
                 # The lane's next input rides the device chain; a stale
                 # override from a previous occupancy must not clobber it.
                 self._overrides.pop(slot, None)
+        if tenant_tokens:
+            for t, n in tenant_tokens.items():
+                self.costs.record_decode(t, n)
         return emitted
 
     def _harvest_spec(self, inflight: _Inflight) -> int:
@@ -750,16 +826,30 @@ class ContinuousBatchingScheduler:
             entry.steps += 1
             entry.next_col += a + 1
             finished = False
+            lane_emitted = 0
             for off in range(a + 1):
                 tok = int(em[slot, off])  # host-ok: harvested device token
                 entry.tokens.append(tok)
                 entry.token_times.append(now)
                 emitted += 1
+                lane_emitted += 1
                 if tok == entry.request.stop_token or \
                         len(entry.tokens) >= entry.budget:
                     self._finish(entry, "completed")
                     finished = True
                     break
+            if self.costs is not None:
+                # Per-lane attribution: the lane's tenant pays for its
+                # gamma draft proposals, its accepted prefix, and the
+                # tokens that actually reached its stream (post stop/
+                # budget truncation) — summing to the aggregate
+                # record_spec below by construction.
+                self.costs.record_spec(
+                    entry.request.tenant, drafted=self.gamma,
+                    accepted=a, emitted=lane_emitted,
+                )
+                self.costs.record_decode(entry.request.tenant,
+                                         lane_emitted)
             if not finished:
                 # Next input rides the device chain (the frontier
                 # sample); drop any stale override for this slot.

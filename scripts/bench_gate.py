@@ -291,6 +291,21 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             # post-cooldown quiet window must produce the scale-down.
             ("scaled_up_under_burst", "equal", 0.0),
             ("scaled_down_after_cooldown", "equal", 0.0),
+            # Tenancy row (--tenants). Attribution must CONSERVE: the
+            # per-tenant prefill+decode token sums must equal the
+            # engine's fleet totals exactly (the committed value is
+            # 0.0 and the equal-rule holds it there — any leak, double
+            # bill, or dropped tag shows up as a nonzero diff). Tagging
+            # must be free under the same 2% absolute ceiling as every
+            # other observability plane, the interactive tenant's
+            # goodput must stay above an absolute floor even while the
+            # batch tenant saturates the pool, and the committed
+            # exemplar-to-trace join bit must stay true (a histogram
+            # p99 that can't name a span tree is a dead end).
+            ("tenant_token_conservation", "equal", 0.0),
+            ("tenant_overhead_pct", "limit", 2.0),
+            ("interactive_goodput_ratio", "floor", 0.25),
+            ("tenant_exemplar_joined", "equal", 0.0),
         ],
     ),
 }

@@ -16,7 +16,11 @@ percentiles, cluster worker ledger, and active alerts. A process whose
 ``/replicas`` roster is non-empty (a fleet router) also gets a replica
 board: per-replica lifecycle STATE, boot, LOAD, affinity hit-rate,
 in-flight count, and worst burn — all ``-`` when the router itself went
-stale/dead, and the signal columns ``-`` for dead replicas.
+stale/dead, and the signal columns ``-`` for dead replicas. A process
+whose ``/tenants`` cost ledger is non-empty also gets a TENANTS board:
+per-tenant requests, prefill/decode tokens, KV block-seconds, spec
+accept rate, goodput and burn — untagged traffic renders as tenant
+``default`` (never dropped), stale/dead procs as ``-`` throughout.
 
 Usage:
     python scripts/fleet_top.py http://127.0.0.1:8801 http://127.0.0.1:8802
@@ -233,6 +237,44 @@ def render(snap: dict) -> str:
                      f"{'LOAD':>6} {'AFF HIT':>8} {'INFLT':>6} {'BURN':>6}")
         for rid, card in sorted((doc.get("replicas") or {}).items()):
             lines.append("  " + _replica_cells(rid, card, proc_status))
+    for proc, doc in sorted((snap.get("per_tenants") or {}).items()):
+        # Per-tenant cost board (obs/tenancy.py). Untagged requests
+        # already bill as tenant "default" in the ledger, so they show
+        # up here as a row, never silently dropped; a stale/dead proc
+        # renders '-' in every signal column, same contract as the
+        # LOAD/SPEC columns above.
+        proc_status = (snap["processes"].get(proc) or {}).get("status", "?")
+        alive = proc_status == "alive"
+        totals = doc.get("totals") or {}
+
+        def tstat(key):
+            v = totals.get(key)
+            return v if alive and v is not None else "-"
+
+        lines.append("")
+        lines.append(f"tenants via {proc}: submitted={tstat('submitted')} "
+                     f"decode_tokens={tstat('decode_tokens')} "
+                     f"requeues={tstat('requeues')}")
+        lines.append(f"  {'TENANT':<12} {'REQS':>5} {'DONE':>5} "
+                     f"{'PREFILL':>8} {'DECODE':>7} {'KV-S':>9} "
+                     f"{'SPEC':>6} {'GOODPUT':>8} {'BURN':>6}")
+        for tenant, row in sorted((doc.get("tenants") or {}).items()):
+            spec = (row.get("spec") or {}).get("accept_rate")
+            good = (row.get("goodput") or {}).get("ratio")
+            burn = (row.get("goodput") or {}).get("burn_worst")
+
+            def cell(v, fmt="{}"):
+                return fmt.format(v) if alive and v is not None else "-"
+
+            lines.append(
+                f"  {tenant:<12} {cell(row.get('submitted')):>5} "
+                f"{cell(row.get('completed')):>5} "
+                f"{cell(row.get('prefill_tokens')):>8} "
+                f"{cell(row.get('decode_tokens')):>7} "
+                f"{cell(row.get('kv_block_seconds'), '{:.2f}'):>9} "
+                f"{cell(None if spec is None else 100.0 * spec, '{:.0f}%'):>6} "
+                f"{cell(None if good is None else 100.0 * good, '{:.1f}%'):>8} "
+                f"{cell(burn, '{:.2f}'):>6}")
     for proc, doc in sorted((snap.get("trials") or {}).items()):
         proc_status = (snap["processes"].get(proc) or {}).get("status", "?")
         counts = doc.get("counts") or {}

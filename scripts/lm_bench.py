@@ -1056,6 +1056,183 @@ def bench_fleet_autoscale() -> dict:
     }
 
 
+def bench_fleet_tenants(compiled, max_slots: int, prompt_len: int,
+                        new_tokens: int, requests: int,
+                        rounds: int = 3, attempts: int = 3) -> dict:
+    """Tenancy guardrail + attribution proof (``--tenants``).
+
+    Two claims in one arm. First, tagging is free: the standard
+    workload with every submit carrying a ``tenant=`` tag must match
+    the untagged arm token-for-token at < 2% throughput cost (same
+    warmup/rounds/best-of discipline as the router overhead arm).
+    Second, attribution is exact: a mixed two-tenant workload —
+    ``interactive`` (short prompts, short decodes) interleaved with
+    ``batch`` (full-length everything) — runs through the router with
+    tracing live, and afterwards the per-tenant ledger must conserve
+    tokens EXACTLY (sum over tenants of prefill/decode tokens ==
+    the engine's ``ServingMetrics`` totals), and at least one
+    ``serving_itl_seconds`` histogram exemplar must join a trace id
+    present in the span dump (the p99-to-span-tree pivot the exemplar
+    plane exists for)."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from elephas_tpu import obs
+    from elephas_tpu.obs import Tracer
+    from elephas_tpu.serving import InferenceEngine, ReplicaSet, Router
+
+    vocab = compiled.module.vocab_size
+    factory = _engine_factory(compiled, max_slots, prompt_len, new_tokens,
+                              max(requests, 1) + 1)
+
+    def run(tagged):
+        engine = factory()
+        stop = threading.Event()
+        th = threading.Thread(target=engine.serve_forever, args=(stop,),
+                              daemon=True)
+        th.start()
+        engine.result(engine.submit([1] * prompt_len, max_new_tokens=2),
+                      timeout_s=60.0)
+        seq = [0]
+
+        def submit(p, n):
+            if not tagged:
+                return engine.submit(p, max_new_tokens=n)
+            seq[0] += 1
+            return engine.submit(
+                p, max_new_tokens=n,
+                tenant="interactive" if seq[0] % 2 else "batch")
+
+        out = _fleet_workload(
+            submit, lambda r: engine.result(r, timeout_s=120.0),
+            vocab, prompt_len, new_tokens, requests)
+        stop.set()
+        th.join(timeout=10.0)
+        return out
+
+    run(True)  # warmup (compile + caches), discarded
+    for attempt in range(attempts):
+        plain, tagged = [], []
+        for r in range(rounds):
+            if r % 2 == 0:
+                plain.append(run(False))
+                tagged.append(run(True))
+            else:
+                tagged.append(run(True))
+                plain.append(run(False))
+        overhead = 1.0 - (max(x[0] for x in tagged)
+                          / max(x[0] for x in plain))
+        if overhead < 0.02:
+            break
+    token_identical = all(x[1] == plain[0][1] for x in plain + tagged)
+
+    # -- attribution proof: mixed two-tenant traffic through the router,
+    # tracing live so the finish-side exemplar latch has ids to latch.
+    tracer = Tracer()
+
+    def traced_factory():
+        return InferenceEngine(
+            compiled, max_slots=max_slots, max_prompt_len=prompt_len,
+            max_len=prompt_len + new_tokens + 1,
+            queue_depth=2 * requests + 4, pipeline=True, tracer=tracer)
+
+    rs = ReplicaSet(traced_factory, initial=1)
+    router = Router(rs)
+    prompt_total = prompt_len  # router warmup bills as tenant "default"
+    router.result(router.submit([1] * prompt_len, max_new_tokens=2),
+                  timeout_s=60.0)
+    rng = np.random.default_rng(11)
+    rids = []
+    by_tenant = {"interactive": [], "batch": []}
+    for i in range(2 * requests):
+        if i % 2 == 0:
+            tenant = "interactive"
+            plen = int(rng.integers(1, max(2, prompt_len // 2)))
+            n = max(2, new_tokens // 4)
+        else:
+            tenant = "batch"
+            plen = prompt_len
+            n = new_tokens
+        prompt = rng.integers(1, vocab, plen).tolist()
+        prompt_total += plen
+        rids.append((tenant,
+                     router.submit(prompt, max_new_tokens=n,
+                                   tenant=tenant)))
+    results = []
+    for tenant, rid in rids:
+        res = router.result(rid, timeout_s=120.0)
+        results.append(res)
+        by_tenant[tenant].append(res)
+
+    engine = next(iter(rs.replicas.values())).engine
+    snap = engine.costs.snapshot()
+    rows = snap["tenants"]
+    dec_diff = (sum(r["decode_tokens"] for r in rows.values())
+                - engine.metrics.tokens_out)
+    pre_diff = (sum(r["prefill_tokens"] for r in rows.values())
+                - prompt_total)
+
+    # Exemplar→trace join: some ITL bucket's latched trace id must be a
+    # trace id the span dump actually contains.
+    reg_ex = obs.default_registry().exemplars().get(
+        "serving_itl_seconds", {})
+    exemplar_ids = {v for v in reg_ex.values() if v}
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "trace.json")
+        tracer.export_chrome(path)
+        with open(path) as f:
+            doc = json.load(f)
+    trace_ids = {(e.get("args") or {}).get("trace_id")
+                 for e in doc.get("traceEvents", ())}
+    exemplar_joined = bool(exemplar_ids & trace_ids)
+
+    def mean(xs):
+        xs = [x for x in xs if x is not None]
+        return sum(xs) / len(xs) if xs else None
+
+    rec = {
+        "mode": "fleet_tenants",
+        "requests": 2 * requests,
+        "rounds": rounds,
+        "attempts_used": attempt + 1,
+        "tokens_per_sec_plain": max(x[0] for x in plain),
+        "tokens_per_sec_tagged": max(x[0] for x in tagged),
+        "tenant_overhead_pct": overhead * 100.0,
+        "token_identical": token_identical,
+        "tenants": sorted(rows),
+        "decode_tokens_by_tenant": {
+            t: r["decode_tokens"] for t, r in sorted(rows.items())},
+        "kv_block_seconds_by_tenant": {
+            t: r["kv_block_seconds"] for t, r in sorted(rows.items())},
+        "queue_seconds_by_tenant": {
+            t: r["queue_seconds"] for t, r in sorted(rows.items())},
+        "ttft_s_avg_by_tenant": {
+            t: mean([r.ttft_s for r in rs_])
+            for t, rs_ in sorted(by_tenant.items())},
+        "itl_s_avg_by_tenant": {
+            t: mean([r.itl_s_avg for r in rs_])
+            for t, rs_ in sorted(by_tenant.items())},
+        "tenant_token_conservation": float(abs(dec_diff) + abs(pre_diff)),
+        "interactive_goodput_ratio": (
+            rows["interactive"]["goodput"]["ratio"]),
+        "batch_goodput_ratio": rows["batch"]["goodput"]["ratio"],
+        "tenant_exemplar_joined": exemplar_joined,
+        "all_completed": all(r.status == "completed" for r in results),
+        "within_2pct": overhead < 0.02,
+    }
+    router.close()
+    assert token_identical, "tagged token streams diverged from untagged"
+    assert rec["tenant_token_conservation"] == 0.0, (
+        f"attribution leak: decode diff {dec_diff}, prefill diff "
+        f"{pre_diff} (per-tenant sums must equal fleet totals exactly)")
+    assert rec["within_2pct"], (
+        f"tenant tagging overhead {overhead * 100.0:.2f}% >= 2% after "
+        f"{attempts} attempts")
+    return rec
+
+
 def main(argv=None) -> list:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--batches", type=int, nargs="+", default=[1, 8, 32])
@@ -1111,6 +1288,13 @@ def main(argv=None) -> list:
                              "session-affinity throughput, kill-a-"
                              "replica-mid-traffic chaos, and the "
                              "autoscaler decision replay")
+    parser.add_argument("--tenants", action="store_true",
+                        help="run the two-tenant cost-attribution arm: "
+                             "tagged-vs-untagged overhead (< 2%%), mixed "
+                             "interactive/batch traffic through the "
+                             "router with exact per-tenant token "
+                             "conservation and the exemplar-to-trace "
+                             "join (appends to the fleet artifact)")
     parser.add_argument("--fleet-out", type=str, default=None,
                         help="write the fleet arms as their own JSON "
                              "artifact (BENCH_FLEET.json)")
@@ -1216,6 +1400,14 @@ def main(argv=None) -> list:
             fleet_records.append(rec)
             records.append(rec)
             print(json.dumps(rec))
+    if args.tenants:
+        rec = bench_fleet_tenants(
+            compiled, args.serving_slots, args.prompt_len, args.new,
+            args.serving_requests,
+        )
+        fleet_records.append(rec)
+        records.append(rec)
+        print(json.dumps(rec))
     if args.trace:
         from elephas_tpu.obs import Tracer
 

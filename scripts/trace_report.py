@@ -31,6 +31,7 @@ completed units (seeded ``FaultPlan`` chaos runs reproduce it).
 
 Usage:
     python scripts/trace_report.py TRACE.json [--tree-req ID]
+        [--tenant ID]
     python scripts/trace_report.py --merge D1.json D2.json...
         [--out MERGED.json]
 (importable: ``report(path) -> str``, ``merge_dumps``, ``unit_table``,
@@ -157,6 +158,21 @@ def pick_request_track(events: List[dict], names: Dict[int, str],
     return best
 
 
+def tenant_tracks(events: List[dict], names: Dict[int, str],
+                  tenant: str) -> set:
+    """tids of ``req:<id>`` tracks belonging to ``tenant``: the
+    scheduler stamps every ``request`` span (and the engine every
+    ``submit`` instant) with a ``tenant`` arg, untagged requests as
+    ``default`` — so membership is read off the events themselves."""
+    tids = set()
+    for e in events:
+        if (e.get("args") or {}).get("tenant") != tenant:
+            continue
+        if names.get(e["tid"], "").startswith("req:"):
+            tids.add(e["tid"])
+    return tids
+
+
 def format_tree(roots: List[dict], indent: str = "") -> List[str]:
     lines = []
     for node in roots:
@@ -176,10 +192,20 @@ def format_tree(roots: List[dict], indent: str = "") -> List[str]:
     return lines
 
 
-def report(path: str, req_id: Optional[int] = None) -> str:
+def report(path: str, req_id: Optional[int] = None,
+           tenant: Optional[str] = None) -> str:
     events = load_events(path)
     names = track_names(path)
     out = [f"# Trace report: {path}", ""]
+    if tenant is not None:
+        # One tenant's view: phase table and tree restricted to the
+        # request tracks whose spans carry this tenant tag.
+        tids = tenant_tracks(events, names, tenant)
+        events = [e for e in events if e["tid"] in tids]
+        out[0] += f" (tenant={tenant}, {len(tids)} request lanes)"
+        if not tids:
+            out.append(f"(no request tracks tagged tenant={tenant})")
+            return "\n".join(out) + "\n"
     if not events:
         out.append("(no duration events)")
         return "\n".join(out)
@@ -416,6 +442,10 @@ def main(argv: Optional[List[str]] = None) -> str:
                              "and print the per-unit critical-path table")
     parser.add_argument("--tree-req", type=int, default=None,
                         help="draw the tree for this req_id")
+    parser.add_argument("--tenant", default=None,
+                        help="restrict the phase table and tree to one "
+                             "tenant's request tracks (untagged "
+                             "requests are tenant 'default')")
     parser.add_argument("--out", default=None,
                         help="write the merged trace (--merge) or the "
                              "report text to this file")
@@ -426,7 +456,8 @@ def main(argv: Optional[List[str]] = None) -> str:
         return text
     if len(args.trace) > 1:
         parser.error("multiple trace files require --merge")
-    text = report(args.trace[0], req_id=args.tree_req)
+    text = report(args.trace[0], req_id=args.tree_req,
+                  tenant=args.tenant)
     if args.out:
         with open(args.out, "w") as f:
             f.write(text)

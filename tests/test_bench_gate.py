@@ -491,6 +491,46 @@ def test_prefix_rules_gate_hit_rate_identity_and_itl_tail():
     assert ("serving/True", "prefix_hit_rate") not in plain_by
 
 
+def test_tenant_rules_gate_conservation_overhead_and_goodput():
+    """The lm_bench --tenants row: token conservation is exact (the
+    committed value is 0.0 — any nonzero per-tenant/fleet diff is a
+    dropped tag or a double bill), the tagged-vs-plain overhead rides
+    the standing 2% absolute ceiling, the interactive tenant's goodput
+    has an absolute floor even with the batch tenant saturating the
+    pool, and the exemplar-to-trace join bit is exact."""
+    base = [{"mode": "fleet_tenants", "tenant_token_conservation": 0.0,
+             "tenant_overhead_pct": -0.4, "interactive_goodput_ratio": 1.0,
+             "tenant_exemplar_joined": True, "token_identical": True,
+             "all_completed": True}]
+    # Overhead drifting above baseline but under the ceiling passes;
+    # goodput dipping below baseline but above the floor passes.
+    drifted = bg.compare(base, [dict(
+        base[0], tenant_overhead_pct=1.7,
+        interactive_goodput_ratio=0.4)], "fleet")
+    assert all(c["ok"] for c in drifted)
+    broken = bg.compare(base, [dict(
+        base[0], tenant_token_conservation=3.0, tenant_overhead_pct=2.8,
+        interactive_goodput_ratio=0.1, tenant_exemplar_joined=False)],
+        "fleet")
+    failed = sorted(c["metric"] for c in broken if not c["ok"])
+    assert failed == ["interactive_goodput_ratio",
+                      "tenant_exemplar_joined",
+                      "tenant_overhead_pct",
+                      "tenant_token_conservation"]
+    by = _checks_by_metric(broken)
+    assert by[("fleet_tenants", "tenant_overhead_pct")]["threshold"] == \
+        "must be <= 2.0"
+    assert by[("fleet_tenants", "interactive_goodput_ratio")][
+        "threshold"] == "must be >= 0.25"
+    # Rows without the tenancy metrics (the routed/kill/autoscale arms)
+    # are untouched by the new rules.
+    plain = [{"mode": "fleet_routed_vs_bare", "routed_overhead_pct": 0.3,
+              "token_identical": True}]
+    plain_by = _checks_by_metric(bg.compare(plain, plain, "fleet"))
+    assert ("fleet_routed_vs_bare", "tenant_token_conservation") \
+        not in plain_by
+
+
 def test_spec_rules_gate_accept_identity_and_itl_ratio():
     """The lm_bench --spec row: token identity vs the unspeculated
     oracle is exact (the speculative contract), the accept rate is an

@@ -748,7 +748,10 @@ def test_alert_rule_validates_inputs():
 
 
 def test_default_rule_pack_uses_registered_vocab():
-    rules = obs.default_rules()
+    # RULE_NAMES is the registered vocabulary across every shipped
+    # pack: the stock training-health rules plus the tenancy pack
+    # (evaluated per-CostLedger, never installed process-wide).
+    rules = obs.default_rules() + obs.tenant_rules()
     assert {r.name for r in rules} == set(obs.RULE_NAMES)
     assert {r.kind for r in rules} <= set(obs.KINDS)
 

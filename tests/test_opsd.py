@@ -211,7 +211,10 @@ def test_ps_mount_serves_staleness_ledger_and_alerts():
         assert status == 200
         names = [r["name"] for r in doc["rules"]]
         assert "staleness_p95_high" in names
-        assert set(names) == set(obs.RULE_NAMES)
+        # The PS serves the stock pack; the tenancy pack lives in
+        # per-CostLedger engines, so the union covers the vocabulary.
+        tenancy = {r.name for r in obs.tenant_rules()}
+        assert set(names) == set(obs.RULE_NAMES) - tenancy
         # The engine reads the PROCESS registry (other tests' workers
         # may legitimately breach there) — w9's two quiet pushes must
         # not, and anything fired uses registered vocabulary.

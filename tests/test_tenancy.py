@@ -1,0 +1,460 @@
+"""Per-tenant cost attribution (``elephas_tpu.obs.tenancy``): the
+``tenant=`` tag from router/engine submit down to the paged KV pool's
+block-second integration, and the conservation invariant the design
+hangs on — the sum over tenants of every billed token equals the
+engine's untagged ``ServingMetrics`` totals, under churn included
+(deadline evictions, COW forks, requeue-on-death).
+
+Pure-ledger tests feed literal samples; the engine/fleet tests reuse
+the serving fixtures so attribution is exercised by the real scheduler
+paths, not mocks.
+"""
+
+import dataclasses
+import json
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from elephas_tpu import obs
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.models import get_model
+from elephas_tpu.obs.tenancy import (
+    DEFAULT_TENANT,
+    CostLedger,
+    merge_tenant_docs,
+    tenant_rules,
+)
+from elephas_tpu.serving import InferenceEngine, ReplicaSet, Router
+from elephas_tpu.serving.kv_pool import PagedKVPool
+from tests.test_serving import FakeClock
+
+VOCAB, SEQ = 97, 64
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return CompiledModel(
+        get_model(
+            "transformer_lm", vocab_size=VOCAB, d_model=32, num_heads=4,
+            num_layers=2, max_seq_len=SEQ,
+        ),
+        optimizer={"name": "adam", "learning_rate": 3e-3},
+        loss="sparse_categorical_crossentropy",
+        metrics=[],
+        input_shape=(SEQ,),
+        input_dtype=jnp.int32,
+        seed=0,
+    )
+
+
+def _engine(compiled, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("max_len", 24)
+    kw.setdefault("queue_depth", 8)
+    return InferenceEngine(compiled, **kw)
+
+
+def _pool(compiled, max_slots=3, max_len=24, **kw):
+    decode_module = dataclasses.replace(
+        compiled.module, decode=True, attention="dense"
+    )
+    kw.setdefault("block_size", 4)
+    return PagedKVPool(decode_module, max_slots, max_len, **kw)
+
+
+class _Bad:
+    """A goodput sample that busts every latency objective."""
+    status, ttft_s, itl_s_avg = "completed", 9.0, 0.9
+
+    def __init__(self, tenant=None):
+        self.tenant = tenant
+
+
+# -- the ledger itself ------------------------------------------------------
+
+
+def test_untagged_requests_bill_the_default_tenant():
+    led = CostLedger(clock=FakeClock())
+    assert CostLedger.resolve(None) == DEFAULT_TENANT
+    assert CostLedger.resolve("") == DEFAULT_TENANT
+    assert CostLedger.resolve("alice") == "alice"
+    led.record_submit(None)
+    led.record_decode(None, 3)
+    led.record_submit("alice")
+    snap = led.snapshot()
+    assert set(snap["tenants"]) == {DEFAULT_TENANT, "alice"}
+    assert snap["tenants"][DEFAULT_TENANT]["decode_tokens"] == 3
+
+
+def test_ledger_sites_accumulate_and_total():
+    clock = FakeClock()
+    led = CostLedger(clock=clock)
+    led.record_submit("a")
+    led.record_queue("a", 1.5)
+    led.record_prefill("a", 8, cached=4)
+    led.record_decode("a", 5)
+    led.record_spec("a", drafted=4, accepted=3, emitted=4)
+    led.record_block_seconds("a", 2.0)
+    led.record_block_seconds("a", 0.5, cow=True)
+    led.record_status("a", "completed")
+    led.record_requeue("a")
+    led.record_reject("b")
+    led.record_status("b", "timeout")
+    snap = led.snapshot()
+    a = snap["tenants"]["a"]
+    assert a["prefill_tokens"] == 8 and a["cached_prefill_tokens"] == 4
+    assert a["decode_tokens"] == 5 and a["queue_seconds"] == 1.5
+    assert a["kv_block_seconds"] == 2.5 and a["cow_copies"] == 1
+    assert a["spec"]["accept_rate"] == 0.75
+    assert a["completed"] == 1 and a["requeues"] == 1
+    b = snap["tenants"]["b"]
+    assert b["rejected"] == 1 and b["timed_out"] == 1
+    assert snap["totals"]["decode_tokens"] == 5
+    assert snap["totals"]["kv_block_seconds"] == 2.5
+
+
+def test_kv_share_needs_a_neighbor():
+    """A single-tenant engine has nobody to be noisy to: the share map
+    is empty until a second tenant holds blocks."""
+    led = CostLedger(clock=FakeClock())
+    led.record_block_seconds("big", 9.0)
+    assert led.kv_share() == {}
+    led.record_block_seconds("small", 1.0)
+    assert led.kv_share() == {"big": 0.9, "small": 0.1}
+
+
+def test_tenant_burn_and_noisy_neighbor_alerts_fire():
+    clock = FakeClock()
+    led = CostLedger(clock=clock)
+    led.record_block_seconds("big", 9.0)
+    led.record_block_seconds("small", 1.0)
+    for _ in range(6):
+        clock.advance(0.5)
+        led.record_goodput(_Bad("big"))
+    fired = led.evaluate_alerts(clock())
+    by_rule = {f["rule"] for f in fired}
+    assert by_rule == {"tenant_burn_high", "noisy_neighbor"}
+    # The breach names the tenant in the synthetic metric key.
+    noisy = [f for f in fired if f["rule"] == "noisy_neighbor"]
+    assert 'tenant="big"' in noisy[0]["metric"]
+    snap = led.alerts_snapshot()
+    assert "tenant_burn" in snap["fired_kinds"]
+    assert "noisy_neighbor" in snap["fired_kinds"]
+
+
+def test_tenancy_vocabulary_is_registered():
+    """The new names live in the registries the static analyzers and
+    dashboards AST-read — an alert kind outside flight.KINDS or a rule
+    outside alerts.RULE_NAMES is invisible vocabulary."""
+    from elephas_tpu.obs.alerts import RULE_NAMES
+    from elephas_tpu.obs.flight import KINDS
+    from elephas_tpu.obs.opsd import ROUTES
+
+    for rule in tenant_rules():
+        assert rule.name in RULE_NAMES
+        assert rule.kind in KINDS
+    assert "/tenants" in ROUTES
+
+
+def test_merge_tenant_docs_sums_counters_keeps_worst_goodput():
+    clock = FakeClock()
+    a, b = CostLedger(clock=clock), CostLedger(clock=clock)
+    a.record_prefill("x", 10)
+    a.record_decode("x", 7)
+    a.record_spec("x", drafted=4, accepted=4, emitted=4)
+    b.record_decode("x", 3)
+    b.record_spec("x", drafted=4, accepted=2, emitted=3)
+    b.record_decode("y", 2)
+    for _ in range(3):
+        clock.advance(0.5)
+        a.record_goodput(_Bad("x"))  # replica a: x is burning
+    merged = merge_tenant_docs([a.snapshot(), b.snapshot()])
+    x = merged["tenants"]["x"]
+    assert x["decode_tokens"] == 10 and x["prefill_tokens"] == 10
+    assert x["spec"]["accept_rate"] == 0.75  # recomputed from sums
+    assert x["goodput"]["burn_worst"] > 1.0  # worst replica wins
+    assert merged["tenants"]["y"]["decode_tokens"] == 2
+    assert merged["totals"]["decode_tokens"] == 12
+    assert merged["merged_from"] == 2
+    assert merge_tenant_docs([])["tenants"] == {}
+
+
+# -- paged-pool block-second billing ----------------------------------------
+
+
+def test_pool_bills_block_seconds_to_owner(compiled):
+    """Occupancy integrates per owner slot in constant-block windows:
+    2 blocks held for 2 s bills exactly 4 block-seconds on release."""
+    clock = FakeClock()
+    led = CostLedger(clock=clock)
+    pool = _pool(compiled)
+    pool.attach_cost_ledger(led, clock)
+    slot = pool.acquire()
+    pool.set_slot_owner(slot, "alice")
+    pool.ensure_cols(slot, 8)  # 2 blocks at block_size=4
+    clock.advance(2.0)
+    pool.release(slot)
+    snap = led.snapshot()
+    assert snap["tenants"]["alice"]["kv_block_seconds"] == pytest.approx(4.0)
+    # Ownership is cleared with the slot: re-acquiring doesn't bill
+    # the old tenant.
+    slot2 = pool.acquire()
+    pool.ensure_cols(slot2, 4)
+    clock.advance(1.0)
+    pool.release(slot2)
+    assert led.snapshot()["tenants"]["alice"]["kv_block_seconds"] == \
+        pytest.approx(4.0)
+
+
+def test_pool_growth_rebills_at_each_block_count(compiled):
+    """The integral is piecewise-constant in block count: growth bills
+    the elapsed window at the OLD count before allocating."""
+    clock = FakeClock()
+    led = CostLedger(clock=clock)
+    pool = _pool(compiled)
+    pool.attach_cost_ledger(led, clock)
+    slot = pool.acquire()
+    pool.set_slot_owner(slot, "a")
+    pool.ensure_cols(slot, 4)   # 1 block from t=0
+    clock.advance(3.0)
+    pool.ensure_cols(slot, 8)   # bills 3s*1block, grows to 2
+    clock.advance(1.0)
+    pool.release(slot)          # bills 1s*2blocks
+    assert led.snapshot()["tenants"]["a"]["kv_block_seconds"] == \
+        pytest.approx(5.0)
+
+
+def test_cow_fork_bills_the_forking_tenant(compiled):
+    """A forked slot inherits the parent's owner; re-owning the child
+    then breaking a shared block bills the COPY (and the child's
+    block-seconds) to the forking tenant, not the parent."""
+    clock = FakeClock()
+    led = CostLedger(clock=clock)
+    pool = _pool(compiled)
+    pool.attach_cost_ledger(led, clock)
+    parent = pool.acquire()
+    pool.set_slot_owner(parent, "parent")
+    pool.ensure_cols(parent, 8)
+    child = pool.fork_slot(parent)
+    assert pool._owner[child] == "parent"  # inherited with the blocks
+    pool.set_slot_owner(child, "forker")
+    clock.advance(1.0)
+    pool.ensure_writable(child, 0)  # breaks the shared block: COW copy
+    clock.advance(1.0)
+    pool.release(child)
+    pool.release(parent)
+    snap = led.snapshot()
+    assert snap["tenants"]["forker"]["cow_copies"] == 1
+    assert snap["tenants"]["forker"]["kv_block_seconds"] > 0.0
+    assert snap["tenants"]["parent"]["cow_copies"] == 0
+    pool.assert_block_invariants()
+
+
+# -- conservation on the real engine ----------------------------------------
+
+
+def test_seeded_run_conserves_tokens_across_tenants(compiled):
+    """The design invariant: decode tokens billed per tenant sum to the
+    untagged ``ServingMetrics.tokens_out``, prefill tokens sum to the
+    admitted prompt tokens — on a mixed tagged/untagged workload."""
+    eng = _engine(compiled)
+    jobs = [
+        ([5, 3, 9], 6, "alice"),
+        ([7, 2, 8, 4], 4, "bob"),
+        ([11, 12], 5, "alice"),
+        ([1, 2, 3], 3, None),  # untagged → default
+    ]
+    rids = [(eng.submit(p, max_new_tokens=n, tenant=t), p)
+            for p, n, t in jobs]
+    results = [eng.result(r, timeout_s=120) for r, _ in rids]
+    assert all(r.status == "completed" for r in results)
+    snap = eng.costs.snapshot()
+    assert set(snap["tenants"]) == {"alice", "bob", DEFAULT_TENANT}
+    assert snap["totals"]["decode_tokens"] == eng.metrics.tokens_out
+    assert snap["totals"]["prefill_tokens"] == \
+        sum(len(p) for p, _, _ in jobs)
+    # Per-tenant decode equals that tenant's emitted tokens exactly.
+    by_tenant = {}
+    for (rid, p), (_, n, t), res in zip(rids, jobs, results):
+        name = t or DEFAULT_TENANT
+        by_tenant[name] = by_tenant.get(name, 0) + len(res.tokens)
+    for name, row in snap["tenants"].items():
+        assert row["decode_tokens"] == by_tenant[name]
+        assert row["completed"] == sum(
+            1 for _, _, t in jobs if (t or DEFAULT_TENANT) == name)
+    assert snap["totals"]["kv_block_seconds"] >= 0.0
+    # The tenancy document rides stats() once any tenant exists.
+    assert "tenancy" in eng.stats()
+    # And the GenerationResult itself carries the tag back out.
+    assert results[0].tenant == "alice" and results[3].tenant is None
+
+
+def test_deadline_evictions_bill_the_evicted_tenant(compiled):
+    """Mid-decode and in-queue evictions both land on the evicted
+    tenant's row (timeout + partial decode tokens + queue seconds), and
+    conservation holds with churn in the mix."""
+    clock = FakeClock()
+    eng = _engine(compiled, max_slots=1, clock=clock)
+    doomed = eng.submit([5, 3, 9], max_new_tokens=1000, timeout_s=5.0,
+                        tenant="victim")
+    queued = eng.submit([3, 4], max_new_tokens=5, timeout_s=2.0,
+                        tenant="queued")
+    for _ in range(3):
+        clock.advance(1.0)
+        eng.step()
+    clock.advance(10.0)  # past both deadlines
+    eng.step()
+    res = eng.result(doomed, timeout_s=10)
+    assert res.status == "timeout" and 0 < len(res.tokens) < 1000
+    assert eng.result(queued, timeout_s=10).status == "timeout"
+    snap = eng.costs.snapshot()
+    victim = snap["tenants"]["victim"]
+    assert victim["timed_out"] == 1
+    assert victim["decode_tokens"] == len(res.tokens)
+    assert victim["kv_block_seconds"] > 0.0  # held real blocks, billed
+    q = snap["tenants"]["queued"]
+    assert q["timed_out"] == 1 and q["decode_tokens"] == 0
+    assert q["queue_seconds"] > 0.0  # queue residency is still cost
+    assert snap["totals"]["decode_tokens"] == eng.metrics.tokens_out
+
+
+def test_spec_decode_billing_conserves_and_attributes(compiled):
+    """Speculative harvest bills per-lane truncated emission: the sum
+    over tenants still equals tokens_out, and accept counts land on the
+    requesting tenant."""
+    eng = _engine(compiled, speculative=True, gamma=3, draft_layers=1)
+    rids = {
+        "a": eng.submit([5, 3, 9], max_new_tokens=6, tenant="a"),
+        "b": eng.submit([7, 2, 8, 4], max_new_tokens=5, tenant="b"),
+    }
+    out = {t: eng.result(r, timeout_s=120) for t, r in rids.items()}
+    assert all(r.status == "completed" for r in out.values())
+    snap = eng.costs.snapshot()
+    assert snap["totals"]["decode_tokens"] == eng.metrics.tokens_out
+    for t, res in out.items():
+        row = snap["tenants"][t]
+        assert row["decode_tokens"] == len(res.tokens)
+        assert row["spec"]["emitted"] >= 0
+    total_spec = sum(snap["tenants"][t]["spec"]["drafted"]
+                     for t in snap["tenants"])
+    assert total_spec > 0  # the spec windows were attributed somewhere
+
+
+# -- fleet: attribution survives requeue-on-death ---------------------------
+
+
+def test_requeue_on_death_keeps_tenant_tag(compiled):
+    """The tag rides the assignment kwargs the requeue replays: kill a
+    replica under live tagged requests and the survivor's ledger shows
+    the SAME tenant (requeues + decode tokens), never 'default'."""
+    def factory():
+        return _engine(compiled, queue_depth=16)
+
+    rs = ReplicaSet(factory, initial=2)
+    router = Router(rs)
+    try:
+        router.result(
+            router.submit([1, 2], max_new_tokens=2, session="s0",
+                          tenant="alice"),
+            timeout_s=30)
+        victim = router.session_replica("s0")
+        rids = [router.submit([5, 3, 9], max_new_tokens=12, session="s0",
+                              tenant="alice") for _ in range(3)]
+        rs.kill(victim)
+        results = [router.result(r, timeout_s=60) for r in rids]
+        assert all(r.status == "completed" for r in results)
+        assert all(r.tenant == "alice" for r in results)
+        assert router.requeues >= 3
+        (survivor,) = [r for r in rs.serving()]
+        snap = survivor.engine.costs.snapshot()
+        alice = snap["tenants"]["alice"]
+        assert alice["requeues"] >= 3  # billed on the receiving replica
+        assert alice["submitted"] >= 3
+        assert alice["decode_tokens"] >= sum(len(r.tokens)
+                                             for r in results)
+        # The router's merged view unions both replicas' ledgers.
+        doc = router._tenants_doc()
+        assert doc["tenants"]["alice"]["requeues"] >= 3
+    finally:
+        router.close()
+
+
+# -- ops surface ------------------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_tenants_ops_route(compiled):
+    eng = _engine(compiled)
+    eng.result(eng.submit([5, 3], max_new_tokens=3, tenant="alice"),
+               timeout_s=120)
+    eng.mount_ops(port=0)
+    try:
+        doc = _get_json(f"http://127.0.0.1:{eng.ops.port}/tenants")
+        assert "alice" in doc["tenants"]
+        assert doc["tenants"]["alice"]["decode_tokens"] == 3
+        assert "alerts" in doc and "kv_share" in doc
+    finally:
+        eng.unmount_ops()
+
+
+def test_fleet_aggregator_federates_tenants_and_fleet_top_renders(compiled):
+    """The aggregator polls /tenants per process, unions the ledgers
+    tenant-wise into the snapshot, and fleet_top renders the TENANTS
+    board with the untagged 'default' row present, never dropped."""
+    from elephas_tpu.obs.fleet import FleetAggregator
+
+    import scripts.fleet_top as fleet_top
+
+    eng = _engine(compiled)
+    eng.result(eng.submit([5, 3, 9], max_new_tokens=4, tenant="alice"),
+               timeout_s=120)
+    eng.result(eng.submit([7, 2], max_new_tokens=3), timeout_s=120)
+    eng.mount_ops(port=0)
+    try:
+        agg = FleetAggregator()
+        agg.add(f"http://127.0.0.1:{eng.ops.port}", name="router")
+        agg.poll()
+        snap = agg.snapshot()
+        merged = snap["tenants"]["tenants"]
+        assert merged["alice"]["decode_tokens"] == 4
+        assert merged[DEFAULT_TENANT]["decode_tokens"] == 3
+        board = fleet_top.render(snap)
+        assert "tenants via router" in board
+        assert "alice" in board and DEFAULT_TENANT in board
+    finally:
+        eng.unmount_ops()
+
+
+# -- exemplars: histogram buckets name their trace --------------------------
+
+
+def test_itl_exemplar_joins_a_live_trace(compiled):
+    """A p99 spike in the ITL histogram must name a span tree: the
+    bucket's latched exemplar id appears as a trace_id in the tracer's
+    Chrome export."""
+    from elephas_tpu.obs.trace import Tracer
+
+    tracer = Tracer()
+    eng = _engine(compiled, tracer=tracer)
+    eng.result(eng.submit([5, 3, 9], max_new_tokens=4, tenant="alice"),
+               timeout_s=120)
+    ex = obs.default_registry().exemplars().get("serving_itl_seconds", {})
+    assert ex, "no exemplar latched on serving_itl_seconds"
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r+", suffix=".json") as f:
+        tracer.export_chrome(f.name)
+        f.seek(0)
+        doc = json.load(f)
+    trace_ids = {e.get("args", {}).get("trace_id")
+                 for e in doc.get("traceEvents", [])}
+    assert set(ex.values()) & trace_ids, (
+        f"exemplar ids {set(ex.values())} joined no exported trace "
+        f"({len(trace_ids)} ids in the dump)")
