@@ -52,6 +52,8 @@ RULE_NAMES = (
     "tune_trial_stalled",
     "tenant_burn_high",
     "noisy_neighbor",
+    "tier_imbalance",
+    "handoff_slow",
 )
 
 _PREDICATES = (">", "<")
@@ -169,6 +171,17 @@ def default_rules() -> List[AlertRule]:
         # is the operator-facing heads-up that a re-lease is coming.
         AlertRule("tune_trial_stalled", "tune_trial_stall_seconds",
                   ">", 120.0, kind="trial_stalled", severity="warn"),
+        # Disaggregated serving topology: the prefill and decode tiers'
+        # average load scores diverging past half the scale means one
+        # tier is starved while the other saturates — rebalance the
+        # tier split (the gauge is 0 on a mono fleet, so the rule
+        # idles). Handoff p99 creeping toward decode-ITL territory
+        # erodes the entire point of tiering — the export/import path
+        # should be microseconds of staging, not a scheduling stall.
+        AlertRule("tier_imbalance", "fleet_tier_imbalance",
+                  ">", 0.5, kind="tier_imbalance", severity="warn"),
+        AlertRule("handoff_slow", "fleet_handoff_seconds_p99",
+                  ">", 0.25, kind="handoff_slow", severity="warn"),
     ]
 
 

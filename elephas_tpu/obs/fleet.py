@@ -471,7 +471,7 @@ class FleetAggregator:
         # serve them, and their absence must not fail the whole poll —
         # each is fetched in its own tolerant attempt.
         for route in ("/load", "/slo", "/replicas", "/incidents",
-                      "/trials", "/tenants"):
+                      "/trials", "/tenants", "/tiers"):
             try:
                 scrape[route[1:]] = json.loads(
                     self.fetch(f"{entry.url}{route}", self.timeout))
@@ -551,6 +551,12 @@ class FleetAggregator:
         per_tenants = {e.name: e.scrape["tenants"]
                        for e in entries
                        if e.scrape.get("tenants", {}).get("tenants")}
+        # Disaggregated tier topology (/tiers): only routers actually
+        # running tiers contribute (a non-empty tier table) — like
+        # /replicas, this is a per-router document, never summed.
+        per_tiers = {e.name: e.scrape["tiers"]
+                     for e in entries
+                     if e.scrape.get("tiers", {}).get("tiers")}
         from elephas_tpu.obs.tenancy import merge_tenant_docs
         merged_tenants = merge_tenant_docs(
             [per_tenants[k] for k in sorted(per_tenants)])
@@ -573,4 +579,5 @@ class FleetAggregator:
             "trials": per_trials,
             "per_tenants": per_tenants,
             "tenants": merged_tenants,
+            "tiers": per_tiers,
         }

@@ -96,6 +96,20 @@ KINDS = (
     # tenants are also paying for blocks
     "tenant_burn",
     "noisy_neighbor",
+    # disaggregated serving (serving/fleet/): a prefill replica's KV
+    # blocks handed off to a decode replica (kv_handoff), or the
+    # handoff failed and the request degraded to a local re-prefill
+    # (tier_handoff_fail); QoS admission throttled a tenant's submit
+    # (admission_throttle) or preempted its queued request to seat a
+    # higher-priority one (tenant_preempted)
+    "kv_handoff",
+    "tier_handoff_fail",
+    "admission_throttle",
+    "tenant_preempted",
+    # disagg alert-plane kinds (obs/alerts.py): tier load divergence
+    # and handoff-latency p99 breaches
+    "tier_imbalance",
+    "handoff_slow",
 )
 
 

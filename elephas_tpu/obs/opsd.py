@@ -94,6 +94,7 @@ ROUTES = (
     "/incidents",
     "/trials",
     "/tenants",
+    "/tiers",
 )
 
 
@@ -157,6 +158,10 @@ class OpsServer:
         per-tenant token/queue/block-second costs, goodput, and the
         tenancy alert state; routers serve the tenant-wise union over
         their replicas); empty ledger when unset.
+    tiers_fn: the ``/tiers`` payload (a ``Router.tiers_doc`` —
+        per-tier membership/load/KV pressure, KV-handoff latency and
+        failure counts, and the QoS policy card for disaggregated
+        prefill/decode serving); empty topology when unset.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
@@ -176,7 +181,8 @@ class OpsServer:
                  replicas_fn: Optional[Callable[[], Dict]] = None,
                  incidents_fn: Optional[Callable[[], Dict]] = None,
                  trials_fn: Optional[Callable[[], Dict]] = None,
-                 tenants_fn: Optional[Callable[[], Dict]] = None):
+                 tenants_fn: Optional[Callable[[], Dict]] = None,
+                 tiers_fn: Optional[Callable[[], Dict]] = None):
         self._requested_port = port
         self.host = host if host is not None else _default_bind_host()
         self._registry = registry
@@ -200,6 +206,7 @@ class OpsServer:
         self._incidents_fn = incidents_fn
         self._trials_fn = trials_fn
         self._tenants_fn = tenants_fn
+        self._tiers_fn = tiers_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_wall = None
@@ -227,6 +234,7 @@ class OpsServer:
         self._add_route("/incidents", self._h_incidents)
         self._add_route("/trials", self._h_trials)
         self._add_route("/tenants", self._h_tenants)
+        self._add_route("/tiers", self._h_tiers)
 
     def _add_route(self, path: str, handler: Callable) -> None:
         self._routes[path] = handler
@@ -398,6 +406,15 @@ class OpsServer:
         return 200, {"tenants": {}, "totals": {}, "kv_share": {},
                      "alerts": {"active": [], "fired": [],
                                 "fired_kinds": []}}
+
+    def _h_tiers(self, query):
+        if self._tiers_fn is not None:
+            return 200, self._tiers_fn()
+        return 200, {"disagg_active": False, "tiers": {},
+                     "imbalance": 0.0,
+                     "handoffs": {"count": 0, "fails": 0,
+                                  "p50_ms": None, "p99_ms": None},
+                     "preemptions": 0, "qos": None}
 
     def start(self) -> "OpsServer":
         if self._httpd is not None:

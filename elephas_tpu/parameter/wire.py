@@ -51,7 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from elephas_tpu.utils.sockets import (MAGIC_NOTMOD, MAGIC_REJECT,
+from elephas_tpu.utils.sockets import (MAGIC_KV, MAGIC_NOTMOD, MAGIC_REJECT,
                                        MAGIC_TREE, RawPayload)
 
 __all__ = [
@@ -61,10 +61,12 @@ __all__ = [
     "NotModified",
     "WireFormatError",
     "decode",
+    "decode_kv_blocks",
     "decode_payload",
     "decode_payload_traced",
     "decode_pickle",
     "decode_push",
+    "encode_kv_blocks",
     "encode_not_modified",
     "encode_pickle",
     "encode_rejected",
@@ -183,7 +185,8 @@ class DecodedTree:
 def is_packed(buf) -> bool:
     """True iff ``buf`` starts with a packed-codec magic."""
     head = bytes(memoryview(buf)[:4])
-    return head == MAGIC_TREE or head == MAGIC_NOTMOD or head == MAGIC_REJECT
+    return (head == MAGIC_TREE or head == MAGIC_NOTMOD
+            or head == MAGIC_REJECT or head == MAGIC_KV)
 
 
 # -- structure skeleton -------------------------------------------------------
@@ -485,6 +488,108 @@ def decode_payload_traced(buf, expect_treedef=None):
                 f"status frame {out!r} where a tree was expected")
         return out.tree, out.trace
     return decode_pickle(buf), None
+
+
+# -- KV-block handoff frames --------------------------------------------------
+#
+# The disaggregated-serving payload kind: a prefill replica ships one
+# request's filled KV blocks (plus the block-table/prefix-chain metadata
+# the decode side needs to rebind them) as
+#
+#     [magic "EPKV"][u32 header_len][header JSON][pad][payload region]
+#
+# — the same layout discipline as EPK1 frames (64B-aligned leaf offsets,
+# zero-copy encode via memoryview chunks, zero-copy decode via
+# np.frombuffer views), but with a free-form JSON ``meta`` dict instead
+# of a pytree skeleton: the serving layer owns the metadata schema
+# (tokens, block size, chain keys), the codec owns only bytes.
+
+
+def encode_kv_blocks(meta: Dict[str, Any], arrays: List[np.ndarray]) -> Frames:
+    """Encode a KV handoff: JSON-able ``meta`` + a list of block arrays.
+
+    Each array lands contiguously at a 64B-aligned offset in one payload
+    region; the header carries ``(dtype, shape, offset, nbytes)`` rows in
+    list order so ``decode_kv_blocks`` restores them positionally.
+    Raises ``WireFormatError`` for non-JSON meta or object-dtype arrays.
+    """
+    rows = []
+    payload_chunks: List[Any] = []
+    offset = 0
+    for leaf in arrays:
+        arr = np.ascontiguousarray(leaf)
+        if arr.dtype == object:
+            raise WireFormatError("object-dtype leaf has no wire layout")
+        pad = (-offset) % _ALIGN
+        if pad:
+            payload_chunks.append(b"\x00" * pad)
+            offset += pad
+        rows.append([arr.dtype.name, list(arr.shape), offset, arr.nbytes])
+        payload_chunks.append(_leaf_chunk(arr))
+        offset += arr.nbytes
+    try:
+        header = json.dumps({"v": 1, "meta": meta, "leaves": rows},
+                            separators=(",", ":")).encode()
+    except (TypeError, ValueError) as exc:
+        raise WireFormatError(
+            f"KV handoff meta is not JSON-able: {exc}") from exc
+    header += b" " * ((-(_PREFIX + len(header))) % _ALIGN)
+    head = MAGIC_KV + _HLEN.pack(len(header)) + header
+    return Frames([head, *payload_chunks])
+
+
+def decode_kv_blocks(buf) -> Tuple[Dict[str, Any], List[np.ndarray]]:
+    """Decode one ``EPKV`` frame → ``(meta, arrays)``.
+
+    Arrays are read-only ``np.frombuffer`` views into ``buf`` (keep it
+    alive as long as the arrays). Every structural defect — wrong magic,
+    truncation, corrupt JSON, a leaf overrunning the payload — raises
+    ``WireFormatError``; the serving layer's reject path maps that to a
+    local re-prefill instead of a wedged slot.
+    """
+    mv = memoryview(buf)
+    if mv.ndim != 1 or mv.format != "B":
+        mv = mv.cast("B")
+    if bytes(mv[:4]) != MAGIC_KV:
+        raise WireFormatError(
+            f"not a KV handoff frame (magic {bytes(mv[:4])!r})")
+    if len(mv) < _PREFIX:
+        raise WireFormatError("truncated KV handoff frame header")
+    (hlen,) = _HLEN.unpack_from(mv, 4)
+    if _PREFIX + hlen > len(mv):
+        raise WireFormatError("KV handoff frame shorter than its header length")
+    try:
+        header = json.loads(bytes(mv[_PREFIX:_PREFIX + hlen]))
+    except ValueError as exc:
+        raise WireFormatError(f"corrupt KV handoff header: {exc}") from exc
+    if header.get("v") != 1 or not isinstance(header.get("leaves"), list):
+        raise WireFormatError(
+            f"unsupported KV handoff frame version {header.get('v')!r}")
+    payload = mv[_PREFIX + hlen:]
+    arrays = []
+    for row in header["leaves"]:
+        try:
+            dtype_name, shape, offset, nbytes = row
+        except (TypeError, ValueError) as exc:
+            raise WireFormatError(f"malformed KV leaf row {row!r}") from exc
+        if offset + nbytes > len(payload):
+            raise WireFormatError(
+                f"KV leaf at offset {offset} (+{nbytes}B) overruns the "
+                f"{len(payload)}B payload region (truncated frame?)"
+            )
+        dtype = _np_dtype(dtype_name)
+        try:
+            arr = np.frombuffer(payload, dtype=dtype,
+                                count=nbytes // dtype.itemsize,
+                                offset=offset).reshape(shape)
+        except (TypeError, ValueError) as exc:
+            raise WireFormatError(
+                f"KV leaf {row!r} does not reshape: {exc}") from exc
+        arrays.append(arr)
+    meta = header.get("meta")
+    if not isinstance(meta, dict):
+        raise WireFormatError("KV handoff frame carries no meta dict")
+    return meta, arrays
 
 
 def decode_push(buf, expect_treedef=None):

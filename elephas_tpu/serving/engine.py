@@ -721,9 +721,17 @@ class InferenceEngine:
         timeout_s: Optional[float] = None,
         canary: bool = False,
         tenant: Optional[str] = None,
+        prefill_only: bool = False,
     ) -> int:
         """Enqueue a request; returns its id. Raises ``QueueFull`` (with
         ``.retry_after``) when admission control rejects it.
+
+        ``prefill_only=True`` (paged engines only) runs this engine as a
+        PREFILL TIER member for the request: the prompt prefills into
+        paged blocks as usual, but instead of joining the decode batch
+        the filled blocks export as a KV handoff — claim it with
+        ``handoff()`` and ship it to a decode replica's
+        ``submit_handoff``.
 
         ``canary=True`` tags the request as a blackbox probe: it rides
         the identical admission/prefill/decode path but its finished
@@ -746,6 +754,9 @@ class InferenceEngine:
             )
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prefill_only and not self.paged:
+            raise ValueError("prefill_only requires paged=True (the KV "
+                             "handoff ships paged blocks)")
         now = self.clock()
         req = Request(
             req_id=next(self._req_ids),
@@ -760,6 +771,7 @@ class InferenceEngine:
             # hop) or root a fresh one — either way every span and
             # exemplar this request produces carries one trace id.
             ctx=obs.current_context() or obs.new_context(),
+            prefill_only=prefill_only,
         )
         if canary:
             with self._cond:
@@ -800,6 +812,112 @@ class InferenceEngine:
                 time.sleep(max(delay, err.retry_after))
         raise AssertionError("unreachable")
 
+    # -- disaggregated serving (prefill tier ↔ decode tier) ------------------
+
+    def submit_prefill(self, prompt: Sequence[int], **kwargs) -> int:
+        """Prefill-tier submit: identical admission to ``submit``, but
+        the request terminates at the prompt — claim its exported KV
+        blocks with ``handoff()`` and ship them to a decode replica."""
+        return self.submit(prompt, prefill_only=True, **kwargs)
+
+    def handoff(self, req_id: int, timeout_s: Optional[float] = None):
+        """Block until ``req_id``'s prefill finishes and claim its
+        exported KV handoff (the dict ``serving.handoff.encode_handoff``
+        frames). Drives the scheduler inline when no serve thread is
+        mid-step, exactly like ``result()``. Returns the handoff dict —
+        or the ``GenerationResult`` when the request terminated on this
+        engine instead (deadline eviction mid-prefill); callers
+        type-check."""
+        deadline = None if timeout_s is None else self.clock() + timeout_s
+        while True:
+            data = self.scheduler.pop_handoff(req_id)
+            if data is not None:
+                return data
+            with self._cond:
+                if req_id in self._results:
+                    return self._results.pop(req_id)
+            if not self._halted and self._step_lock.acquire(blocking=False):
+                try:
+                    finished = [] if self._halted else self.scheduler.step()
+                finally:
+                    self._step_lock.release()
+                self._publish(finished)
+                continue
+            with self._cond:
+                self._cond.wait(timeout=0.01)
+            if deadline is not None and self.clock() >= deadline:
+                raise TimeoutError(
+                    f"handoff {req_id} not ready in {timeout_s}s")
+
+    def submit_handoff(self, frame, canary: bool = False) -> int:
+        """Decode-tier admission of a packed ``KVHandoff`` frame: decode
+        it (``WireFormatError`` on any corruption — nothing binds until
+        the frame validates), import the blocks into this engine's pool,
+        and join the decode batch at the prompt frontier. Returns the
+        LOCAL request id (``result()`` claims it). Raises ``QueueFull``
+        when no slot is free — the router tries another decode replica
+        or falls back to a local re-prefill.
+
+        Cost accounting: no ``record_submit`` here — the prefill engine
+        already billed the submit, the prompt, and the first token;
+        this engine bills decode tokens from token two and block-seconds
+        from the import instant (the window the exporter closed)."""
+        if not self.paged:
+            raise RuntimeError("KV handoff import requires paged=True")
+        from elephas_tpu.serving.handoff import decode_handoff
+
+        data = decode_handoff(frame)
+        prompt = [int(t) for t in data["prompt"]]  # host-ok: wire metadata
+        if not 1 <= len(prompt) <= self.max_prompt_len:
+            raise ValueError(
+                f"handoff prompt length {len(prompt)} outside [1, "
+                f"{self.max_prompt_len}]"
+            )
+        req = Request(
+            req_id=next(self._req_ids),
+            prompt=prompt,
+            max_new_tokens=int(data["max_new_tokens"]),  # host-ok: wire metadata
+            stop_token=data["stop_token"],
+            timeout_s=None,
+            submitted_at=float(data["submitted_at"]),  # host-ok: wire metadata
+            deadline=data["deadline"],
+            tenant=data["tenant"],
+            ctx=obs.current_context() or obs.new_context(),
+        )
+        if canary:
+            with self._cond:
+                self._canary_ids.add(req.req_id)
+        export = data["export"]
+        try:
+            with self._step_lock:
+                _, finished = self.scheduler.admit_import(
+                    req, int(data["first"]), prompt,  # host-ok: wire metadata
+                    export["arrays"], leaf_names=export.get("leaves"),
+                )
+        except Exception:
+            if canary:
+                with self._cond:
+                    self._canary_ids.discard(req.req_id)
+            raise
+        self._publish(finished)
+        return req.req_id
+
+    def cancel(self, req_id: int) -> bool:
+        """QoS preemption: yank ``req_id`` from the queue if it has not
+        been admitted yet, publishing a ``"preempted"`` terminal result
+        (claimable via ``result()``; excluded from SLO/goodput — the
+        router redispatches it). Returns False once the request holds a
+        slot — admitted work is never clawed back."""
+        with self._step_lock:
+            result = self.scheduler.cancel_queued(req_id)
+        if result is None:
+            return False
+        # Keeps submitted == completed + timed_out + rejected on this
+        # engine: a preemption is a late reject, never a completion.
+        self.metrics.record_reject()
+        self._publish([result])
+        return True
+
     def _publish(self, finished: List[GenerationResult]) -> None:
         """Make finished results claimable and account goodput — canary
         probes publish (the driver claims them via ``result()``) but are
@@ -807,7 +925,12 @@ class InferenceEngine:
         if not finished:
             return
         with self._cond:
-            real = [r for r in finished if r.req_id not in self._canary_ids]
+            # Preempted results are deferrals, not failures: the router
+            # redispatches them under fair-share, and only the eventual
+            # terminal result may move SLO/goodput accounting.
+            real = [r for r in finished
+                    if r.req_id not in self._canary_ids
+                    and r.status != "preempted"]
             for r in finished:
                 self._results[r.req_id] = r
                 self._canary_ids.discard(r.req_id)

@@ -45,10 +45,16 @@ seeded burst replays exactly.
 """
 
 from elephas_tpu.serving.fleet.autoscaler import FleetAutoscaler  # noqa: F401
+from elephas_tpu.serving.fleet.qos import (  # noqa: F401
+    AdmissionThrottled,
+    QoSPolicy,
+    TokenBucket,
+)
 from elephas_tpu.serving.fleet.replica import (  # noqa: F401
     DEAD,
     DRAINING,
     LIFECYCLES,
+    TIERS,
     Replica,
     ReplicaDead,
     SERVING,

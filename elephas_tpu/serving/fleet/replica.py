@@ -41,11 +41,18 @@ from elephas_tpu.obs.canary import CanaryDriver
 from elephas_tpu.utils import locksan
 
 __all__ = ["DEAD", "DRAINING", "LIFECYCLES", "Replica", "ReplicaDead",
-           "SERVING"]
+           "SERVING", "TIERS"]
 
 SERVING = "serving"
 DRAINING = "draining"
 DEAD = "dead"
+
+#: Serving tiers. ``mono`` replicas run the classic prefill+decode
+#: loop; a disaggregated fleet splits them — ``prefill`` replicas stop
+#: at the prompt and export KV handoffs, ``decode`` replicas import
+#: those handoffs and run the token loop. Every tier is the SAME
+#: engine; the tier only changes which requests the router sends it.
+TIERS = ("mono", "prefill", "decode")
 
 #: Replica lifecycle states, in the order a drain walks them.
 LIFECYCLES = (SERVING, DRAINING, DEAD)
@@ -114,8 +121,14 @@ class Replica:
                  *, clock: Callable[[], float] = time.monotonic,
                  mount_ops: bool = False,
                  store_dir: Optional[str] = None,
-                 canary_timeout_s: float = CANARY_TIMEOUT_S):
+                 canary_timeout_s: float = CANARY_TIMEOUT_S,
+                 tier: str = "mono"):
+        if tier not in TIERS:
+            raise ValueError(f"unknown tier {tier!r}; expected one of {TIERS}")
         self.replica_id = replica_id
+        #: Which traffic the router sends here (see ``TIERS``). Fixed
+        #: for the slot's lifetime — a re-tiering is a new slot.
+        self.tier = tier
         self.engine_factory = engine_factory
         self.clock = clock
         self.mount_ops = mount_ops
@@ -279,6 +292,26 @@ class Replica:
                 if deadline is not None and self.clock() >= deadline:
                     raise
 
+    def handoff(self, req_id: int, timeout_s: Optional[float] = None):
+        """Claim a prefill-tier KV handoff, staying alert to death —
+        the same sliced-wait / last-claim / ``ReplicaDead`` contract as
+        ``result()``. Returns the handoff dict, or a
+        ``GenerationResult`` when the request terminated locally
+        (deadline eviction mid-prefill)."""
+        deadline = None if timeout_s is None else self.clock() + timeout_s
+        while True:
+            if self.state == DEAD and not self.drained:
+                try:
+                    return self.engine.handoff(req_id, timeout_s=0.0)
+                except TimeoutError:
+                    raise ReplicaDead(self.replica_id, req_id) from None
+            try:
+                return self.engine.handoff(req_id,
+                                           timeout_s=RESULT_SLICE_S)
+            except TimeoutError:
+                if deadline is not None and self.clock() >= deadline:
+                    raise
+
     # -- signals -----------------------------------------------------------
 
     def load_score(self) -> float:
@@ -290,6 +323,15 @@ class Replica:
     def queue_frac(self) -> float:
         """Admission-queue fullness in [0, 1]."""
         return len(self.engine.queue) / self.engine.queue.max_depth
+
+    def kv_pressure(self) -> float:
+        """Fraction of the paged KV pool in use, in [0, 1] (0.0 when
+        the engine has no paged pool). The decode-tier dispatch signal:
+        a decode replica out of free blocks cannot import a handoff
+        without evicting prefix state first."""
+        sig = self.engine.load.snapshot().get("signals") or {}
+        free = sig.get("kv_free_frac")
+        return 0.0 if free is None else max(0.0, 1.0 - free)
 
     def worst_burn(self) -> float:
         """Worst-objective multi-window burn (0.0 before any traffic)
@@ -313,6 +355,7 @@ class Replica:
         and ``fleet_top``'s replica board."""
         doc: Dict[str, Any] = {
             "state": self.state,
+            "tier": self.tier,
             "boot": self.boot,
             "drained": self.drained,
             "in_flight": self.in_flight,
@@ -320,6 +363,7 @@ class Replica:
             "queue_depth": None,
             "queue_frac": None,
             "burn_worst": None,
+            "kv_pressure": None,
             "shedding": False,
             "canary_probes": 0,
             "canary_failures": 0,
@@ -333,6 +377,7 @@ class Replica:
             doc["queue_frac"] = self.queue_frac()
             doc["burn_worst"] = self.worst_burn()
             doc["shedding"] = self.shedding
+            doc["kv_pressure"] = self.kv_pressure()
         if self.canary is not None:
             doc["canary_probes"] = self.canary.probes
             doc["canary_failures"] = self.canary.failures

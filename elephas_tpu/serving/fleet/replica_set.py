@@ -33,11 +33,16 @@ class ReplicaSet:
 
     def __init__(self, engine_factory: Callable[[], Any], *,
                  initial: int = 1,
+                 tiers: Optional[Dict[str, int]] = None,
                  clock: Callable[[], float] = time.monotonic,
                  mount_ops: bool = False,
                  store_root: Optional[str] = None):
-        if initial < 1:
-            raise ValueError(f"initial must be >= 1, got {initial}")
+        if tiers is None:
+            if initial < 1:
+                raise ValueError(f"initial must be >= 1, got {initial}")
+        elif not tiers or any(n < 1 for n in tiers.values()):
+            raise ValueError(f"tiers must map tier -> count >= 1, "
+                             f"got {tiers}")
         self.engine_factory = engine_factory
         self.clock = clock
         self.mount_ops = mount_ops
@@ -46,16 +51,24 @@ class ReplicaSet:
         self.store_root = store_root
         self._seq = itertools.count()
         self.replicas: Dict[str, Replica] = {}
-        for _ in range(initial):
-            self.spawn()
+        # A disaggregated roster spawns per-tier slots instead of
+        # ``initial`` monoliths, e.g. tiers={"prefill": 1, "decode": 2}.
+        if tiers is None:
+            for _ in range(initial):
+                self.spawn()
+        else:
+            for tier, count in tiers.items():
+                for _ in range(count):
+                    self.spawn(tier=tier)
 
-    def spawn(self) -> Replica:
+    def spawn(self, tier: str = "mono") -> Replica:
         """Add a new slot to the roster and boot it."""
         rid = f"r{next(self._seq)}"
         store_dir = (os.path.join(self.store_root, rid, "telemetry")
                      if self.store_root else None)
         rep = Replica(rid, self.engine_factory, clock=self.clock,
-                      mount_ops=self.mount_ops, store_dir=store_dir)
+                      mount_ops=self.mount_ops, store_dir=store_dir,
+                      tier=tier)
         rep.spawn()
         self.replicas[rid] = rep
         return rep
@@ -66,9 +79,12 @@ class ReplicaSet:
     def __len__(self) -> int:
         return len(self.replicas)
 
-    def serving(self) -> List[Replica]:
-        """Replicas currently accepting new work, in id order."""
-        return [r for r in self.replicas.values() if r.state == SERVING]
+    def serving(self, tier: Optional[str] = None) -> List[Replica]:
+        """Replicas currently accepting new work, in id order —
+        optionally only those of one tier."""
+        return [r for r in self.replicas.values()
+                if r.state == SERVING
+                and (tier is None or r.tier == tier)]
 
     def drain(self, replica_id: str, *, reason: str = "operator") -> None:
         self.replicas[replica_id].drain(reason=reason)

@@ -63,6 +63,12 @@ COST_BURN_WEIGHT = 0.25
 #: Burn saturates the cost term at critical territory (>6 is already
 #: page-worthy; beyond that the number carries no routing signal).
 BURN_COST_CAP = 8.0
+#: Decode-tier handoff targeting adds KV pressure at full weight — a
+#: decode replica out of free blocks evicts prefix state on import,
+#: which is exactly the waste tiering exists to avoid.
+COST_KV_WEIGHT = 1.0
+#: Handoff latency samples kept for the /tiers p50/p99 (bounded ring).
+HANDOFF_SAMPLES = 512
 
 
 class FleetUnavailable(RuntimeError):
@@ -72,16 +78,23 @@ class FleetUnavailable(RuntimeError):
 
 class _Assignment:
     """Where one routed request currently lives (mutable: requeue
-    re-points it at a new replica/engine id)."""
+    re-points it at a new replica/engine id).
+
+    ``stage`` tracks the disaggregated pipeline position: ``"mono"``
+    (classic single-replica serving), ``"prefill"`` (waiting on a
+    prefill-tier KV export), or ``"decode"`` (handed off; waiting on
+    the decode replica's result). Requeues and handoff failures
+    degrade the stage back to ``"mono"``.
+    """
 
     __slots__ = ("router_id", "prompt", "kwargs", "session", "canary",
                  "replica_id", "engine_rid", "t_router", "t_engine",
-                 "resubmits")
+                 "resubmits", "stage")
 
     def __init__(self, router_id: int, prompt: Sequence[int],
                  kwargs: Dict[str, Any], session: Optional[str],
                  canary: bool, replica_id: str, engine_rid: int,
-                 t_router: float, t_engine: float):
+                 t_router: float, t_engine: float, stage: str = "mono"):
         self.router_id = router_id
         self.prompt = prompt
         self.kwargs = kwargs
@@ -92,6 +105,7 @@ class _Assignment:
         self.t_router = t_router
         self.t_engine = t_engine
         self.resubmits = 0
+        self.stage = stage
 
 
 class _RouterOutcome:
@@ -114,7 +128,8 @@ class Router:
 
     def __init__(self, replica_set: ReplicaSet, *,
                  clock=None, autoscaler=None,
-                 canary_fail_threshold: int = 1):
+                 canary_fail_threshold: int = 1,
+                 qos=None):
         if canary_fail_threshold < 1:
             raise ValueError(
                 f"canary_fail_threshold must be >= 1, "
@@ -123,6 +138,11 @@ class Router:
         self.clock = replica_set.clock if clock is None else clock
         self.autoscaler = autoscaler
         self.canary_fail_threshold = canary_fail_threshold
+        #: Optional multi-tenant admission policy (fleet.qos.QoSPolicy):
+        #: token buckets + weighted fair share gate every non-canary
+        #: submit; priority-0 tenants may preempt queued lower-priority
+        #: work when every replica rejects admission.
+        self.qos = qos
         #: Router-relative goodput: the client's view of the fleet,
         #: including dispatch and requeue stalls no single engine sees.
         self.slo = GoodputLedger(clock=self.clock)
@@ -140,6 +160,11 @@ class Router:
         self.affinity_hits = 0
         self.affinity_misses = 0
         self.requeues = 0
+        self.handoffs = 0
+        self.handoff_fails = 0
+        self.preemptions = 0
+        #: Bounded handoff-latency ring (seconds) for /tiers p50/p99.
+        self._handoff_s: List[float] = []
         reg = obs.default_registry()
         self._m_requests = reg.counter(
             "router_requests_total",
@@ -156,6 +181,26 @@ class Router:
             "router_requeue_total",
             help="in-flight requests resubmitted to another replica "
                  "after their replica died un-drained")
+        self._m_handoff = reg.counter(
+            "router_handoff_total",
+            help="prefill-tier KV exports successfully imported by a "
+                 "decode replica")
+        self._m_handoff_fail = reg.counter(
+            "router_handoff_fail_total",
+            help="KV handoffs that failed (corrupt frame, no decode "
+                 "capacity, dead target) and degraded to a local "
+                 "re-prefill")
+        self._m_preempt = reg.counter(
+            "router_preempt_total",
+            help="queued lower-priority requests cancelled to seat a "
+                 "priority-0 submit")
+        self._g_imbalance = reg.gauge(
+            "fleet_tier_imbalance",
+            help="max minus min average load score across populated "
+                 "serving tiers (0 with fewer than two tiers)")
+        self._g_handoff_p99 = reg.gauge(
+            "fleet_handoff_seconds_p99",
+            help="p99 of recent prefill->decode KV handoff latency")
 
     # -- dispatch ----------------------------------------------------------
 
@@ -165,6 +210,20 @@ class Router:
         return (rep.load_score()
                 + COST_QUEUE_WEIGHT * rep.queue_frac()
                 + COST_BURN_WEIGHT * burn)
+
+    def decode_cost(self, rep: Replica) -> float:
+        """Handoff-target cost: the dispatch composite plus KV-pool
+        pressure — the signal that actually predicts whether an import
+        will evict prefix state."""
+        return self.dispatch_cost(rep) + COST_KV_WEIGHT * rep.kv_pressure()
+
+    def _disagg_active(self) -> bool:
+        """Disaggregated routing is on when both tiers have a serving
+        replica. Canaries always serve mono-style (one replica end to
+        end) — a blackbox probe must measure one replica, not the
+        pipeline."""
+        return bool(self.replica_set.serving("prefill")
+                    and self.replica_set.serving("decode"))
 
     def _dispatch_order(
             self, pinned: Optional[str]) -> Tuple[List[Replica], bool]:
@@ -189,6 +248,65 @@ class Router:
                         True)
         return ranked, False
 
+    def _tier_order(self, tier: str) -> List[Replica]:
+        """Serving replicas of one tier in dispatch order (shed latch
+        first, then cost, then id — same ranking as mono dispatch)."""
+        return sorted(
+            self.replica_set.serving(tier),
+            key=lambda r: (r.shedding, self.dispatch_cost(r), r.replica_id))
+
+    def _admit_on(self, order: List[Replica], prompt: Sequence[int],
+                  kwargs: Dict[str, Any],
+                  prefill: bool) -> Tuple[Replica, int]:
+        """Try each candidate in order; first admission wins. Raises
+        the last ``QueueFull`` when every one rejected."""
+        last_full = None
+        for candidate in order:
+            try:
+                engine_rid = candidate.engine.submit(
+                    prompt, prefill_only=prefill, **kwargs)
+            except QueueFull as err:
+                last_full = err
+                continue
+            return candidate, engine_rid
+        if last_full is None:
+            raise FleetUnavailable("no serving replica")
+        raise last_full
+
+    def _try_preempt(self, beneficiary: Optional[str],
+                     replica_ids: List[str]) -> Optional[Replica]:
+        """Cancel one still-queued lower-priority request on one of
+        ``replica_ids`` to free an admission seat. Lowest-priority
+        (highest class number) victims go first; admitted work is
+        never touched (``engine.cancel`` only yanks queued requests —
+        the victim's waiter sees a ``"preempted"`` result and the
+        router redispatches it). Returns the replica whose seat was
+        freed, or None."""
+        if self.qos is None:
+            return None
+        bene_prio = self.qos.priority(beneficiary)
+        with self._lock:
+            candidates = [a for a in self._assignments.values()
+                          if a.replica_id in replica_ids
+                          and not a.canary]
+        candidates.sort(
+            key=lambda a: -self.qos.priority(a.kwargs.get("tenant")))
+        for victim in candidates:
+            v_tenant = victim.kwargs.get("tenant")
+            if self.qos.priority(v_tenant) <= bene_prio:
+                break  # sorted: nothing lower-priority remains
+            rep = self.replica_set.get(victim.replica_id)
+            if rep.engine.cancel(victim.engine_rid):
+                self.preemptions += 1
+                self._m_preempt.inc()
+                self.qos.note_preempted(v_tenant)
+                obs.default_flight_recorder().note(
+                    "tenant_preempted", "warn", tenant=v_tenant,
+                    beneficiary=beneficiary, replica=victim.replica_id,
+                    router_id=victim.router_id)
+                return rep
+        return None
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                *, session: Optional[str] = None,
                timeout_s: Optional[float] = None,
@@ -201,32 +319,48 @@ class Router:
         replay kwargs, so a requeue-on-death resubmits with the SAME
         tag — attribution survives mid-flight replica kills.
 
-        Raises ``FleetUnavailable`` when no replica is serving, or the
-        last replica's ``QueueFull`` when every one rejected admission.
+        With both a prefill and a decode tier serving (and ``qos`` not
+        throttling the tenant), a non-canary request is dispatched to
+        the prefill tier; ``result()`` drives the KV handoff to a
+        decode replica. Raises ``FleetUnavailable`` when no replica is
+        serving, ``AdmissionThrottled`` when QoS refuses the tenant,
+        or the last replica's ``QueueFull`` when every one rejected
+        admission (after a failed preemption attempt, for priority-0
+        tenants).
         """
         t_router = self.clock()
+        if self.qos is not None and not canary:
+            self.qos.try_admit(tenant, len(prompt) + max_new_tokens)
         with self._lock:
             pinned = None if session is None else self._sessions.get(session)
-        order, pin_held = self._dispatch_order(pinned)
-        last_full = None
-        rep = None
-        engine_rid = None
-        for candidate in order:
-            try:
-                engine_rid = candidate.engine.submit(
-                    prompt, max_new_tokens=max_new_tokens,
-                    timeout_s=timeout_s, canary=canary, tenant=tenant)
-            except QueueFull as err:
-                last_full = err
-                continue
-            rep = candidate
-            break
-        if rep is None:
-            raise last_full
+        disagg = (not canary) and self._disagg_active()
+        kwargs = {"max_new_tokens": max_new_tokens, "timeout_s": timeout_s,
+                  "canary": canary, "tenant": tenant}
+        if disagg:
+            # The session pin (if any) points at a decode replica
+            # holding KV state; prefill dispatch ignores it — the
+            # handoff targeting honors it instead (_do_handoff).
+            order = self._tier_order("prefill")
+            pin_held = False
+        else:
+            order, pin_held = self._dispatch_order(pinned)
+        try:
+            rep, engine_rid = self._admit_on(order, prompt, kwargs,
+                                             prefill=disagg)
+        except QueueFull:
+            if (self.qos is None or canary
+                    or self.qos.priority(tenant) != 0):
+                raise
+            freed = self._try_preempt(
+                tenant, [r.replica_id for r in order])
+            if freed is None:
+                raise
+            rep, engine_rid = self._admit_on([freed], prompt, kwargs,
+                                             prefill=disagg)
 
         self.requests += 1
         self._m_requests.inc()
-        if pinned is not None:
+        if pinned is not None and not disagg:
             card = self._affinity.setdefault(
                 rep.replica_id, {"hits": 0, "misses": 0})
             if pin_held and rep.replica_id == pinned:
@@ -241,14 +375,13 @@ class Router:
 
         router_id = next(self._ids)
         asg = _Assignment(
-            router_id, list(prompt),
-            {"max_new_tokens": max_new_tokens, "timeout_s": timeout_s,
-             "canary": canary, "tenant": tenant},
+            router_id, list(prompt), kwargs,
             session, canary, rep.replica_id, engine_rid,
-            t_router, self.clock())
+            t_router, self.clock(),
+            stage="prefill" if disagg else "mono")
         with self._lock:
             self._assignments[router_id] = asg
-            if session is not None:
+            if session is not None and not disagg:
                 self._sessions[session] = rep.replica_id
         return router_id
 
@@ -260,7 +393,12 @@ class Router:
 
         A ``ReplicaDead`` from the assigned replica resubmits the
         request on the next-best replica and keeps waiting — the
-        client sees one slower result, never the outage.
+        client sees one slower result, never the outage. A
+        ``"prefill"``-stage assignment first waits for the KV export,
+        then drives the handoff to a decode replica (falling back to a
+        local re-prefill on any handoff failure); a ``"preempted"``
+        result redispatches under fair share. Either way the client
+        sees exactly one terminal result.
         """
         with self._lock:
             asg = self._assignments.get(router_id)
@@ -272,12 +410,28 @@ class Router:
             rep = self.replica_set.get(asg.replica_id)
             remaining = (None if deadline is None
                          else max(0.0, deadline - self.clock()))
-            try:
-                res = rep.result(asg.engine_rid, timeout_s=remaining)
-            except ReplicaDead:
-                self._requeue(asg)
-                continue
-            rep.note_done()
+            if asg.stage == "prefill":
+                try:
+                    data = rep.handoff(asg.engine_rid,
+                                       timeout_s=remaining)
+                except ReplicaDead:
+                    self._requeue(asg)
+                    continue
+                if isinstance(data, dict):
+                    self._do_handoff(asg, rep, data, deadline=deadline)
+                    continue
+                res = data  # terminated on the prefill engine
+                rep.note_done()
+            else:
+                try:
+                    res = rep.result(asg.engine_rid, timeout_s=remaining)
+                except ReplicaDead:
+                    self._requeue(asg)
+                    continue
+                rep.note_done()
+                if res.status == "preempted":
+                    self._redispatch(asg, deadline=deadline)
+                    continue
             with self._lock:
                 self._assignments.pop(router_id, None)
             if not asg.canary:
@@ -286,6 +440,134 @@ class Router:
                 self.slo.record(
                     _RouterOutcome(res.status, ttft, res.itl_s_avg))
             return res
+
+    def _do_handoff(self, asg: _Assignment, rep: Replica,
+                    data: Dict[str, Any],
+                    deadline: Optional[float] = None) -> None:
+        """Ship a claimed KV export to the best decode replica; on any
+        failure, degrade to a local re-prefill (``_redispatch``) — the
+        request is never lost, only slower (and token-identical either
+        way: the fallback recomputes the same prompt on the same
+        params and seed).
+
+        Latency is measured from export claim to accepted import —
+        encode, (in-process) transfer, validation, and the device
+        staging of every block land inside the number. A decode tier
+        that is merely FULL is backpressure, not failure: the loop
+        re-ranks and retries with bounded sleeps until ``deadline``
+        (same discipline as ``_redispatch``) — only a structural
+        defect (corrupt frame, import rejection), an empty tier, or
+        deadline exhaustion degrades to the local re-prefill.
+        """
+        from elephas_tpu.parameter.wire import WireFormatError
+        from elephas_tpu.serving.handoff import encode_handoff
+
+        t0 = self.clock()
+        failure = None
+        try:
+            frame = encode_handoff(data).tobytes()
+        except WireFormatError as exc:
+            frame, failure = None, repr(exc)
+        while frame is not None:
+            targets = sorted(
+                self.replica_set.serving("decode"),
+                key=lambda r: (r.shedding, self.decode_cost(r),
+                               r.replica_id))
+            with self._lock:
+                pinned = (None if asg.session is None
+                          else self._sessions.get(asg.session))
+            if pinned is not None:
+                lead = next(
+                    (r for r in targets if r.replica_id == pinned), None)
+                if lead is not None and not lead.shedding:
+                    targets = [lead] + [r for r in targets if r is not lead]
+            structural = False
+            retry_after = None
+            for cand in targets:
+                try:
+                    new_rid = cand.engine.submit_handoff(
+                        frame, canary=asg.canary)
+                except QueueFull as err:
+                    failure = repr(err)
+                    retry_after = (err.retry_after if retry_after is None
+                                   else min(retry_after, err.retry_after))
+                    continue
+                except (WireFormatError, ValueError) as err:
+                    failure = repr(err)
+                    structural = True
+                    break  # structural defect; other targets won't help
+                t1 = self.clock()
+                self.handoffs += 1
+                self._m_handoff.inc()
+                self._handoff_s.append(t1 - t0)
+                del self._handoff_s[:-HANDOFF_SAMPLES]
+                rep.note_done()
+                cand.note_dispatch()
+                obs.default_flight_recorder().note(
+                    "kv_handoff", "info", tenant=asg.kwargs.get("tenant"),
+                    src=rep.replica_id, dst=cand.replica_id,
+                    blocks=data["export"]["blocks"],
+                    matched=data["matched"],
+                    ms=round((t1 - t0) * 1e3, 3))
+                with self._lock:
+                    asg.replica_id = cand.replica_id
+                    asg.engine_rid = new_rid
+                    asg.stage = "decode"
+                    asg.t_engine = t1
+                    if asg.session is not None:
+                        self._sessions[asg.session] = cand.replica_id
+                return
+            if not targets:
+                failure = "no serving decode replica"
+            if structural or retry_after is None:
+                break
+            if deadline is not None and self.clock() >= deadline:
+                break
+            time.sleep(min(max(retry_after, 0.01), 0.05))
+            # Queue wait is backpressure, not transport: restart the
+            # latency sample so handoff_p99 keeps measuring the
+            # encode→import path, not how long the decode tier was full.
+            t0 = self.clock()
+        self.handoff_fails += 1
+        self._m_handoff_fail.inc()
+        obs.default_flight_recorder().note(
+            "tier_handoff_fail", "warn", tenant=asg.kwargs.get("tenant"),
+            src=rep.replica_id, reason=failure,
+            router_id=asg.router_id)
+        rep.note_done()
+        self._redispatch(asg, deadline=deadline)
+
+    def _redispatch(self, asg: _Assignment,
+                    deadline: Optional[float] = None) -> None:
+        """Re-run dispatch for an assignment whose replica already
+        released it (handoff failure, preemption): mono-style, to any
+        serving replica — correctness over tiering when the pipeline
+        degrades. The caller has already ``note_done``d the old
+        replica.
+
+        A full fleet is retried with bounded sleeps until ``deadline``
+        — a preempted victim often races the very preemptor that freed
+        its seat, and losing the request to that race would turn a
+        deferral into a failure."""
+        while True:
+            order, _ = self._dispatch_order(None)
+            try:
+                rep, engine_rid = self._admit_on(
+                    order, asg.prompt, asg.kwargs, prefill=False)
+                break
+            except QueueFull as err:
+                if deadline is not None and self.clock() >= deadline:
+                    raise
+                time.sleep(min(max(err.retry_after, 0.01), 0.05))
+        rep.note_dispatch()
+        with self._lock:
+            asg.replica_id = rep.replica_id
+            asg.engine_rid = engine_rid
+            asg.stage = "mono"
+            asg.resubmits += 1
+            asg.t_engine = self.clock()
+            if asg.session is not None:
+                self._sessions[asg.session] = rep.replica_id
 
     def _requeue(self, asg: _Assignment) -> None:
         """Move a stranded assignment off its dead replica."""
@@ -323,6 +605,10 @@ class Router:
         with self._lock:
             asg.replica_id = rep.replica_id
             asg.engine_rid = engine_rid
+            # The replay is a plain submit — a prefill-stage
+            # assignment degrades to mono on its new replica (its KV
+            # export died with the old one).
+            asg.stage = "mono"
             asg.resubmits += 1
             asg.t_engine = self.clock()
             if (asg.session is not None
@@ -413,11 +699,67 @@ class Router:
                 "affinity_hits": self.affinity_hits,
                 "affinity_misses": self.affinity_misses,
                 "requeues": self.requeues,
+                "handoffs": self.handoffs,
+                "handoff_fails": self.handoff_fails,
+                "preemptions": self.preemptions,
                 "sessions": sessions,
                 "in_flight": in_flight,
             },
             "autoscale": (None if self.autoscaler is None
                           else self.autoscaler.snapshot()),
+        }
+
+    @staticmethod
+    def _pctl(samples: List[float], q: float) -> Optional[float]:
+        if not samples:
+            return None
+        ordered = sorted(samples)
+        idx = min(len(ordered) - 1,
+                  int(q * len(ordered)))  # host-ok: host-side latencies
+        return ordered[idx]
+
+    def tiers_doc(self) -> Dict[str, Any]:
+        """The ``/tiers`` ops document: per-tier membership and
+        pressure, handoff latency/failure stats, and the QoS policy
+        card. Publishing refreshes the ``fleet_tier_imbalance`` and
+        ``fleet_handoff_seconds_p99`` gauges (the alert plane's
+        inputs)."""
+        tiers: Dict[str, Any] = {}
+        for rep in self.replica_set.replicas.values():
+            card = tiers.setdefault(rep.tier, {
+                "replicas": [], "serving": 0,
+                "avg_load": None, "avg_kv_pressure": None,
+                "_loads": [], "_kv": []})
+            card["replicas"].append(rep.replica_id)
+            if rep.state == "serving":
+                card["serving"] += 1
+                card["_loads"].append(rep.load_score())
+                card["_kv"].append(rep.kv_pressure())
+        for card in tiers.values():
+            loads, kv = card.pop("_loads"), card.pop("_kv")
+            if loads:
+                card["avg_load"] = sum(loads) / len(loads)
+                card["avg_kv_pressure"] = sum(kv) / len(kv)
+        avgs = [c["avg_load"] for c in tiers.values()
+                if c["avg_load"] is not None]
+        imbalance = (max(avgs) - min(avgs)) if len(avgs) >= 2 else 0.0
+        self._g_imbalance.set(imbalance)
+        samples = list(self._handoff_s)
+        p50 = self._pctl(samples, 0.50)
+        p99 = self._pctl(samples, 0.99)
+        self._g_handoff_p99.set(0.0 if p99 is None else p99)
+        return {
+            "disagg_active": self._disagg_active(),
+            "tiers": tiers,
+            "imbalance": imbalance,
+            "handoffs": {
+                "count": self.handoffs,
+                "fails": self.handoff_fails,
+                "p50_ms": None if p50 is None else round(p50 * 1e3, 3),
+                "p99_ms": None if p99 is None else round(p99 * 1e3, 3),
+            },
+            "preemptions": self.preemptions,
+            "qos": None if self.qos is None else self.qos.snapshot(),
         }
 
     def mount_ops(self, port: int = 0, host: Optional[str] = None):
@@ -444,6 +786,7 @@ class Router:
             slo_fn=self.slo.snapshot,
             replicas_fn=self.replicas_doc,
             tenants_fn=self._tenants_doc,
+            tiers_fn=self.tiers_doc,
         ).start()
         return self.ops
 

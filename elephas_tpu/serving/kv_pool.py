@@ -225,6 +225,28 @@ def _copy_block(cache, src, dst):
     return jax.tree_util.tree_map(cp, cache)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_imported_blocks(cache, ids, payload, slot, next_col):
+    """Scatter imported handoff block data into the paged cache and set
+    ``slot``'s index vectors to the handoff's write frontier — the device
+    half of ``PagedKVPool.import_blocks``. ``payload`` is a tuple of
+    ``(n, heads, block_size, head_dim)`` uploads, one per rank-4 K/V
+    leaf in tree order; the cache is donated (n block rows written in
+    place, not a whole-pool copy). Retraces per distinct block count —
+    bounded by ``blocks_per_slot``, and warmed by the first handoffs."""
+    it = iter(payload)
+
+    def put(path, leaf):
+        if leaf.ndim == 4:
+            return leaf.at[ids].set(next(it).astype(leaf.dtype))
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("cache_index", "pos_index"):
+            return leaf.at[slot].set(next_col.astype(leaf.dtype))
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(put, cache)
+
+
 class BlockTable:
     """Host-side ``slot -> physical block ids`` map with a lazily
     uploaded device mirror.
@@ -715,6 +737,156 @@ class PagedKVPool(KVCachePool):
         self.table.clear_row(slot)
         self._free.append(slot)
         self._mirror_push()
+
+    # -- cross-tier KV handoff -----------------------------------------------
+    #
+    # The disaggregated-serving transfer unit: a prefill replica exports
+    # one slot's filled blocks through contiguous host buffers
+    # (``export_blocks``), the wire codec frames them
+    # (``parameter.wire.encode_kv_blocks``), and the decode replica
+    # rebinds them into its own pool (``import_blocks``) — refcounts are
+    # TRANSFERRED, not copied: the exporter's references drop with its
+    # normal ``release``, the importer derives fresh references locally
+    # (slot row + prefix-chain entries), and the billing window moves
+    # with the blocks (closed at export, reopened by the importer's
+    # ``set_slot_owner``) so cross-tier block-seconds never double-bill.
+
+    def _kv_leaf_names(self) -> Tuple[List[str], List]:
+        """(names, leaves) of every rank-4 K/V leaf in tree order —
+        the deterministic leaf enumeration both handoff sides share
+        (same model config → same tree → same order)."""
+        names, leaves = [], []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self.cache)[0]:
+            if getattr(leaf, "ndim", 0) == 4:
+                names.append(jax.tree_util.keystr(path))
+                leaves.append(leaf)
+        return names, leaves
+
+    def export_blocks(self, slot: int) -> Dict:
+        """Gather ``slot``'s resident blocks into contiguous host
+        buffers for a cross-tier handoff.
+
+        Returns ``{"block_size", "blocks", "leaves", "arrays"}`` —
+        ``arrays[i]`` is the ``(blocks, heads, block_size, head_dim)``
+        host copy of leaf ``leaves[i]`` at the slot's block ids, in row
+        order. Also CLOSES the slot's block-seconds billing window (the
+        satellite-6 fix): occupancy up to this instant bills the owning
+        tenant here, and the subsequent local ``release`` bills nothing
+        — the decode replica's ``set_slot_owner`` opens the fresh
+        window, so summed cross-tier block-seconds equal a monolithic
+        run's within one billing window instead of double-counting the
+        in-flight span."""
+        from elephas_tpu.serving import host_sync
+
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is free; nothing to export")
+        row = self.table.rows[slot]
+        n = int((row >= 0).sum())  # host-ok: numpy table
+        if n == 0:
+            raise ValueError(f"slot {slot} has no resident blocks")
+        ids = [int(row[i]) for i in range(n)]  # host-ok: numpy table
+        # Close the billing window: bill up to now, then drop the
+        # window so release()'s closing bill is a no-op for this slot.
+        self._bill_slot(slot)
+        self._owner.pop(slot, None)
+        self._billed_at.pop(slot, None)
+        names, leaves = self._kv_leaf_names()
+        ids_dev = jnp.asarray(np.array(ids, np.int32))  # host-ok: host list
+        host = host_sync.fetch([leaf[ids_dev] for leaf in leaves])
+        return {
+            "block_size": self.block_size,
+            "blocks": n,
+            "leaves": names,
+            "arrays": [np.ascontiguousarray(a) for a in host],
+        }
+
+    def import_blocks(self, slot: int, tokens: Sequence[int],
+                      arrays: Sequence[np.ndarray],
+                      leaf_names: Optional[Sequence[str]] = None) -> int:
+        """Rebind an exported block set to ``slot`` of THIS pool.
+
+        ``tokens`` is the chain the blocks hold (the prompt plus the
+        prefill-sampled first token's columns are NOT included — exactly
+        the columns with K/V written, as the exporter's scheduler knew
+        them). The local prefix cache is consulted first: matched
+        full-block prefixes admit by incref (the cross-tier prefix hit
+        — a shared system prompt costs zero uploads past its first
+        import), only the remaining blocks allocate and upload, and the
+        full-block chain is inserted into this pool's ``PrefixCache``
+        so later handoffs and local admissions share it. Returns the
+        matched token count. The caller owns slot acquisition and
+        ``set_slot_owner`` (which opens the billing window the exporter
+        closed). Raises ``ValueError`` on any structural mismatch —
+        callers map that to the handoff reject path."""
+        bs = self.block_size
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is free; acquire it first")
+        names, leaves = self._kv_leaf_names()
+        if leaf_names is not None and list(leaf_names) != names:
+            raise ValueError(
+                f"handoff leaf structure mismatch: got {list(leaf_names)}, "
+                f"this pool has {names}"
+            )
+        if len(arrays) != len(names):
+            raise ValueError(
+                f"handoff carries {len(arrays)} leaves, pool has {len(names)}"
+            )
+        n_blocks = int(arrays[0].shape[0]) if arrays else 0  # host-ok: host array
+        for name, leaf, arr in zip(names, leaves, arrays):
+            want = (n_blocks,) + tuple(leaf.shape[1:])
+            if tuple(arr.shape) != want:
+                raise ValueError(
+                    f"handoff leaf {name} shape {tuple(arr.shape)} != {want}"
+                )
+            if np.dtype(arr.dtype) != np.dtype(leaf.dtype):
+                raise ValueError(
+                    f"handoff leaf {name} dtype {arr.dtype} != {leaf.dtype}"
+                )
+        if not tokens or n_blocks != -(-len(tokens) // bs):
+            raise ValueError(
+                f"handoff block count {n_blocks} does not back "
+                f"{len(tokens)} tokens at block size {bs}"
+            )
+        if n_blocks > self.blocks_per_slot:
+            raise ValueError(
+                f"handoff needs {n_blocks} blocks/slot, rows have "
+                f"{self.blocks_per_slot}"
+            )
+        self._bill_slot(slot)  # close the zero-block window pre-bind
+        matched, mblocks = (
+            self.prefix.match(tokens) if self.prefix is not None else (0, [])
+        )
+        for i, b in enumerate(mblocks):
+            self._incref(b)
+            self.table.set(slot, i, b)
+        start = matched // bs
+        fresh = []
+        try:
+            for i in range(start, n_blocks):
+                b = self._alloc_block()
+                self.table.set(slot, i, b)
+                fresh.append(b)
+        except RuntimeError:
+            # Out of blocks mid-import: unwind every reference this
+            # import took so the slot releases clean (the caller's
+            # reject path re-prefills locally; nothing may leak).
+            for i in range(start + len(fresh)):
+                self._decref(int(self.table.rows[slot][i]))  # host-ok: numpy table
+            self.table.clear_row(slot)
+            raise
+        # matched < len(tokens) (match is strictly shorter), so at least
+        # one block always uploads — the jit also sets the index vectors.
+        ids_dev = jnp.asarray(np.array(fresh, np.int32))  # host-ok: host list
+        payload = tuple(
+            jnp.asarray(np.ascontiguousarray(a[start:])) for a in arrays
+        )
+        self.swap(_write_imported_blocks(
+            self.cache, ids_dev, payload, jnp.int32(slot),
+            jnp.int32(len(tokens)),
+        ))
+        self.commit_prefix(slot, tokens)
+        self._mirror_push()
+        return matched
 
     # -- compiled-program operands -------------------------------------------
 

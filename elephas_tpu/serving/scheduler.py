@@ -82,6 +82,10 @@ class Request:
     # (request spans, the ITL histogram's exemplar latch) re-activates
     # it so /metrics joins to this request's span tree.
     ctx: Any = None
+    # Disaggregated serving: a prefill-tier request stops at the
+    # prompt — instead of joining the decode batch, its filled blocks
+    # export as a KV handoff (``pop_handoff``) for a decode replica.
+    prefill_only: bool = False
 
 
 @dataclass
@@ -135,6 +139,16 @@ class RequestQueue:
     def pop(self) -> Optional[Request]:
         with self._lock:
             return self._items.pop(0) if self._items else None
+
+    def remove(self, req_id: int) -> Optional[Request]:
+        """Pull a still-queued request out by id (QoS preemption: a
+        queued victim can be yanked and requeued elsewhere — once popped
+        into a slot it is no longer preemptible here). None if absent."""
+        with self._lock:
+            for i, req in enumerate(self._items):
+                if req.req_id == req_id:
+                    return self._items.pop(i)
+        return None
 
     def __len__(self) -> int:
         with self._lock:
@@ -287,6 +301,9 @@ class ContinuousBatchingScheduler:
         self.tracer = tracer if tracer is not None else obs.default_tracer()
         self._active: Dict[int, _Active] = {}  # slot -> _Active
         self._prefilling: Dict[int, _Prefilling] = {}  # paged mid-prefill
+        # req_id -> exported handoff (prefill-only requests park their
+        # finished prompt here for the engine's ``pop_handoff``).
+        self._handoffs: Dict[int, Dict] = {}
         self._results: List[GenerationResult] = []
         self._inflight: Optional[_Inflight] = None
         # slot -> first token to splice into the NEXT dispatch (set by
@@ -600,6 +617,9 @@ class ContinuousBatchingScheduler:
         del self._prefilling[pf.slot]
         self.pool.commit_prefix(pf.slot, req.prompt)
         self.pool.admitted_total += 1
+        if req.prefill_only:
+            self._finalize_handoff(pf, first, t_pre1)
+            return
         # Same budget as the contiguous pool (capacity from the FIXED
         # prompt width, not this prompt's length) — oracle parity.
         budget = min(
@@ -637,6 +657,135 @@ class ContinuousBatchingScheduler:
             self._finish(entry, "completed")
         else:
             self._overrides[pf.slot] = first
+
+    def _finalize_handoff(self, pf: _Prefilling, first: int,
+                          t_pre1: float) -> None:
+        """Prefill-tier terminal: the whole prompt is on device, so
+        instead of joining the decode batch the slot's blocks export as
+        a KV handoff and the slot releases (its chain stays published in
+        THIS pool's prefix cache, so sibling prompts on the prefill tier
+        keep hitting). ``export_blocks`` closes the block-seconds
+        billing window; the importing pool's owner declaration opens the
+        next one. The prefill side bills the prompt (prefix discount
+        visible) and the prefill-sampled first token — the decode side
+        bills from token two, so cross-tier token sums equal the
+        monolithic run's."""
+        req = pf.request
+        export = self.pool.export_blocks(pf.slot)
+        chain = list(req.prompt)
+        self.pool.release(pf.slot, tokens=chain)
+        self._handoffs[req.req_id] = {
+            "req_id": req.req_id,
+            "prompt": chain,
+            "first": first,
+            "max_new_tokens": req.max_new_tokens,
+            "stop_token": req.stop_token,
+            "deadline": req.deadline,
+            "submitted_at": req.submitted_at,
+            "tenant": req.tenant,
+            "matched": pf.matched,
+            "export": export,
+        }
+        if self.costs is not None:
+            self.costs.record_prefill(req.tenant, len(req.prompt),
+                                      cached=pf.matched)
+            self.costs.record_decode(req.tenant, 1)
+        if self.tracer.enabled:
+            track = f"req:{req.req_id}"
+            self.tracer.record(
+                "queue", req.submitted_at, pf.t_pop, track=track,
+                req_id=req.req_id,
+            )
+            self.tracer.record(
+                "prefill", pf.t_pre0, t_pre1, track=track,
+                req_id=req.req_id, prompt_tokens=len(req.prompt),
+                cached_tokens=pf.matched,
+            )
+            self.tracer.instant(
+                "handoff_export", at=self.clock(), track=track,
+                req_id=req.req_id, blocks=export["blocks"],
+            )
+
+    def pop_handoff(self, req_id: int) -> Optional[Dict]:
+        """Claim a parked handoff (None until its prefill finishes)."""
+        return self._handoffs.pop(req_id, None)
+
+    def admit_import(self, request: Request, first: int,
+                     chain: List[int], arrays,
+                     leaf_names=None) -> Tuple[int, List[GenerationResult]]:
+        """Decode-tier admission of an imported handoff: bind the
+        shipped blocks to a fresh slot and join the decode batch exactly
+        where the prefill side left off (``next_col`` at the prompt
+        frontier, the prefill-sampled first token riding in as the next
+        dispatch's override — token-identical to the monolithic path by
+        construction). Returns ``(slot, finished)`` — ``finished`` is
+        non-empty only when the first token already terminated the
+        request (stop token / budget of 1), and the caller publishes it
+        (``step``'s result slicing never returns admissions made between
+        steps). Raises ``QueueFull`` when no slot is free (the router
+        retries another decode replica or falls back to a local
+        re-prefill); any import error unwinds the slot completely."""
+        before = len(self._results)
+        slot = self.pool.acquire()
+        if slot is None:
+            raise QueueFull(self.pool.max_slots, self.pool.max_slots,
+                            self.queue.retry_hint_s)
+        if self.costs is not None and hasattr(self.pool, "set_slot_owner"):
+            self.pool.set_slot_owner(slot, request.tenant)
+        try:
+            self.pool.import_blocks(slot, chain, arrays,
+                                    leaf_names=leaf_names)
+        except Exception:
+            self.pool.release(slot)
+            raise
+        self.pool.admitted_total += 1
+        budget = min(
+            request.max_new_tokens, self.pool.max_len - self.max_prompt_len
+        )
+        entry = _Active(
+            request=request, slot=slot, tokens=[first],
+            token_times=[self.clock()], budget=budget,
+            next_col=len(chain),
+        )
+        entry.admitted_at = self.clock()
+        self._active[slot] = entry
+        if self.tracer.enabled:
+            track = f"req:{request.req_id}"
+            self.tracer.instant(
+                "handoff_import", at=entry.admitted_at, track=track,
+                req_id=request.req_id, tokens=len(chain),
+            )
+            self.tracer.record(
+                "admit", entry.token_times[0], entry.admitted_at,
+                track=track, req_id=request.req_id, slot=slot,
+            )
+        if first == request.stop_token or len(entry.tokens) >= budget:
+            self._finish(entry, "completed")
+        else:
+            self._overrides[slot] = first
+        return slot, self._results[before:]
+
+    def cancel_queued(self, req_id: int) -> Optional[GenerationResult]:
+        """QoS preemption hook: pull ``req_id`` out of the queue if it
+        has not been admitted yet and mint a ``"preempted"`` terminal
+        result for it (the router requeues it under fair-share). Returns
+        the result — the CALLER publishes it (``step``'s result slicing
+        never returns cancellations made between steps) — or None when
+        the request already left the queue: admitted work is never
+        clawed back."""
+        req = self.queue.remove(req_id)
+        if req is None:
+            return None
+        result = GenerationResult(
+            req_id=req.req_id, tokens=[], status="preempted",
+            prompt_tokens=len(req.prompt), tenant=req.tenant,
+        )
+        self._results.append(result)
+        if self.costs is not None:
+            self.costs.record_queue(req.tenant,
+                                    self.clock() - req.submitted_at)
+            self.costs.record_status(req.tenant, "preempted")
+        return result
 
     def _advance_prefills(self) -> None:
         """Run parked prefills forward, FIFO by admission order. With no

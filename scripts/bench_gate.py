@@ -306,6 +306,26 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             ("tenant_overhead_pct", "limit", 2.0),
             ("interactive_goodput_ratio", "floor", 0.25),
             ("tenant_exemplar_joined", "equal", 0.0),
+            # Disaggregated-tiers row (--disagg). token_identical reuses
+            # the equal-rule above: the tiered fleet must serve byte-
+            # equal streams to the monolithic fleet — handoff is a
+            # transport, not a resample. The ITL-interference ratio is
+            # the reason the tiers exist: decode-tier ITL p99 under
+            # long-prompt interference must not EXCEED the monolithic
+            # fleet's (<= 1.0 is the hard line; the committed number
+            # should sit well below it). Handoff latency is an absolute
+            # ceiling sized as encode + one cross-engine import step
+            # with CI headroom — it must not move with whatever a loaded
+            # machine measured last time. The cross-tier prefix floor
+            # holds the shared-system-prompt hit discipline across the
+            # handoff boundary (same 0.5 floor as the single-engine
+            # --prefix row), and the fair-share floor pins the worst
+            # tenant's goodput while the batch tenant saturates the
+            # prefill tier.
+            ("disagg_itl_p99_ratio", "limit", 1.0),
+            ("handoff_p99_ms", "limit", 250.0),
+            ("cross_tier_prefix_hit_rate", "floor", 0.5),
+            ("goodput_floor_min_tenant", "floor", 0.25),
         ],
     ),
 }

@@ -14,9 +14,15 @@ persisted record via ``/incidents``; ``-`` when stale/dead or no store
 is mounted), the fleet-summed counters, pooled histogram
 percentiles, cluster worker ledger, and active alerts. A process whose
 ``/replicas`` roster is non-empty (a fleet router) also gets a replica
-board: per-replica lifecycle STATE, boot, LOAD, affinity hit-rate,
+board: per-replica lifecycle STATE, serving TIER (prefill/decode/mono;
+``-`` for pre-disagg routers), boot, LOAD, affinity hit-rate,
 in-flight count, and worst burn — all ``-`` when the router itself went
-stale/dead, and the signal columns ``-`` for dead replicas. A process
+stale/dead, and the signal columns ``-`` for dead replicas. A router
+running disaggregated tiers (non-empty ``/tiers``) also gets a TIERS
+board: per-tier replica counts, KV-handoff count/failures/latency
+percentiles, tier imbalance, and the QoS policy card (per-tenant
+bucket fill, priority class, fair-share vtime, throttle and
+preemption counts). A process
 whose ``/tenants`` cost ledger is non-empty also gets a TENANTS board:
 per-tenant requests, prefill/decode tokens, KV block-seconds, spec
 accept rate, goodput and burn — untagged traffic renders as tenant
@@ -171,9 +177,12 @@ def _replica_cells(rid: str, card: dict, proc_status: str) -> str:
     total = hits + misses
     rate = f"{100.0 * hits / total:.0f}%" if alive and total else "-"
     state = str(card.get("state", "?")) if alive else "-"
+    # Pre-disagg routers don't stamp a tier — render '-' rather than
+    # guessing mono; the column must tell old from new honestly.
+    tier = str(card.get("tier") or "-") if alive else "-"
     boot = str(card.get("boot", "-")) if alive else "-"
     inflt = str(card.get("in_flight", "-")) if alive else "-"
-    return (f"{rid:<9} {state:<9} {boot:>4} "
+    return (f"{rid:<9} {state:<9} {tier:<8} {boot:>4} "
             f"{num(card.get('load_score')):>6} {rate:>8} {inflt:>6} "
             f"{num(card.get('burn_worst')):>6}")
 
@@ -233,10 +242,51 @@ def render(snap: dict) -> str:
         lines.append(f"replicas via {proc}: requests={rstat('requests')} "
                      f"requeues={rstat('requeues')} "
                      f"sessions={rstat('sessions')}")
-        lines.append(f"  {'REPLICA':<9} {'STATE':<9} {'BOOT':>4} "
+        lines.append(f"  {'REPLICA':<9} {'STATE':<9} {'TIER':<8} {'BOOT':>4} "
                      f"{'LOAD':>6} {'AFF HIT':>8} {'INFLT':>6} {'BURN':>6}")
         for rid, card in sorted((doc.get("replicas") or {}).items()):
             lines.append("  " + _replica_cells(rid, card, proc_status))
+    for proc, doc in sorted((snap.get("tiers") or {}).items()):
+        # Disaggregated-serving board (/tiers): handoff health plus the
+        # QoS policy card — per-tenant bucket fill, priority class,
+        # fair-share vtime, throttle/preemption counts. Stale/dead
+        # routers render '-' everywhere, same contract as every board.
+        proc_status = (snap["processes"].get(proc) or {}).get("status", "?")
+        alive = proc_status == "alive"
+        hand = doc.get("handoffs") or {}
+
+        def hstat(key, fmt="{}"):
+            v = hand.get(key)
+            return fmt.format(v) if alive and v is not None else "-"
+
+        lines.append("")
+        lines.append(
+            f"tiers via {proc}: "
+            + "  ".join(
+                f"{t}={len((c or {}).get('replicas') or [])}"
+                for t, c in sorted((doc.get("tiers") or {}).items()))
+            + f"  handoffs={hstat('count')} fails={hstat('fails')} "
+            f"p50={hstat('p50_ms', '{:.1f}ms')} "
+            f"p99={hstat('p99_ms', '{:.1f}ms')}"
+            + (f"  imbalance={doc.get('imbalance'):.2f}"
+               if alive and doc.get("imbalance") is not None else ""))
+        qos = doc.get("qos") or {}
+        if qos.get("tenants"):
+            lines.append(f"  {'TENANT':<12} {'PRIO':>4} {'WEIGHT':>6} "
+                         f"{'BUCKET':>7} {'VTIME':>9} {'ADMIT':>6} "
+                         f"{'THROT':>6} {'PREEMPT':>7}")
+            for tenant, row in sorted(qos["tenants"].items()):
+                def qcell(key, fmt="{}"):
+                    v = row.get(key)
+                    return fmt.format(v) if alive and v is not None else "-"
+
+                fill = row.get("bucket_fill")
+                lines.append(
+                    f"  {tenant:<12} {qcell('priority'):>4} "
+                    f"{qcell('weight', '{:.1f}'):>6} "
+                    f"{(f'{100.0 * fill:.0f}%' if alive and fill is not None else '-'):>7} "
+                    f"{qcell('vtime', '{:.1f}'):>9} {qcell('admitted'):>6} "
+                    f"{qcell('throttled'):>6} {qcell('preempted'):>7}")
     for proc, doc in sorted((snap.get("per_tenants") or {}).items()):
         # Per-tenant cost board (obs/tenancy.py). Untagged requests
         # already bill as tenant "default" in the ledger, so they show

@@ -31,7 +31,13 @@ artifact (``--out BENCH_DECODE.json``):
   the kill-a-replica-mid-traffic chaos arm (fleet-plane outage arc,
   blackbox canary outage, goodput dip, requeue recovery), and the
   autoscaler's seeded decision replay. Gated by scripts/bench_gate.py
-  ``--fleet``.
+  ``--fleet``,
+- ``{"mode": "fleet_disagg", ...}`` (``--disagg``, appends to the
+  fleet artifact) — disaggregated prefill/decode tiers vs a monolithic
+  fleet on the same two-tenant interference workload: token identity
+  across the KV-block handoff, the decode-tier ITL p99 ratio under
+  long-prompt interference, handoff latency p50/p99, the cross-tier
+  prefix hit rate, and the per-tenant fair-share goodput floor.
 
 Importable (and runnable with tiny defaults) without a TPU — tier-1
 collects it; real numbers come from the dev chip.
@@ -1233,6 +1239,224 @@ def bench_fleet_tenants(compiled, max_slots: int, prompt_len: int,
     return rec
 
 
+def bench_fleet_disagg(compiled, max_slots: int, prompt_len: int,
+                       new_tokens: int, requests: int,
+                       attempts: int = 3) -> dict:
+    """Disaggregated-tiers arm (``--disagg``).
+
+    The same two-tenant interference workload runs through two fleet
+    topologies built from identical paged engines: a 2-replica
+    monolithic fleet, and a 1-prefill + 1-decode tiered fleet where
+    every request is prefilled on the prefill tier and its filled KV
+    blocks cross the wire (``encode_handoff``/``submit_handoff``) to
+    join the decode tier's batch. Four claims on one row:
+
+    1. Identity — the tiered fleet serves byte-equal token streams to
+       the monolithic fleet, request-for-request (the handoff is a
+       transport, not a resample; gate equal-rule).
+    2. Interference — decode-tier ITL p99 with the ``batch`` tenant
+       streaming full-length prompts: on the monolithic fleet every
+       batch prefill stalls a decode step, so the worst per-request
+       mean inter-token gap eats whole prefill forwards; on the decode
+       tier the only foreign work is the (device-side) block import.
+       The committed ratio (decode tier's engine ITL p99 over the
+       worst monolithic engine's) carries an absolute gate ceiling of
+       1.0; retried ``attempts`` times for CI tail jitter. The
+       interactive tenant's per-request view rides the row ungated —
+       at CI scale its means are dominated by scheduler noise, while
+       the engine-level p99 is where a stolen prefill step lands.
+    3. Handoff cost — p50/p99 wall ms of export→encode→import,
+       measured after a per-shape warmup (the import's donating
+       scatter compiles once per block-count shape); p99 gate ceiling.
+    4. QoS — both tenants run under admission (priority 0 vs 2,
+       asymmetric weights) and the WORST tenant's goodput ratio is
+       committed with an absolute floor: fair share may deprioritize
+       the batch tenant, it must not starve it.
+
+    Cross-tier prefix economics ride the same row: every prompt opens
+    with a shared two-block system prefix, so after the first import
+    the decode pool should satisfy each handoff's prefix from resident
+    blocks — the committed hit rate is the fraction of handoffs that
+    re-used at least one resident block (gate floor 0.5).
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from elephas_tpu import obs
+    from elephas_tpu.serving import InferenceEngine, ReplicaSet, Router
+    from elephas_tpu.serving.fleet import QoSPolicy
+
+    vocab = compiled.module.vocab_size
+    block = max(2, prompt_len // 4)
+    sys_prompt = np.random.default_rng(17).integers(
+        1, vocab, 2 * block).tolist()
+    # The interference must be REAL prefill work: batch prompts use the
+    # model's whole sequence budget (the saturating-long-prompt shape
+    # the --prefix ITL arm established), so on the monolithic fleet
+    # every batch admission absorbs a full-length forward between two
+    # decode steps. The decode tier's only foreign work is the block
+    # import — a single donating scatter, whose cost does not grow
+    # with prompt compute.
+    interactive_new = min(new_tokens, 32)
+    long_len = compiled.module.max_seq_len - interactive_new - 1
+
+    def factory():
+        return InferenceEngine(
+            compiled,
+            max_slots=max_slots,
+            max_prompt_len=long_len,
+            max_len=long_len + interactive_new + 1,
+            queue_depth=2 * requests + 8,
+            pipeline=True,
+            paged=True,
+            kv_block_size=block,
+        )
+
+    # One deterministic workload, shared by both arms: interactive
+    # (short suffix, long decode — the ITL victim) interleaved with
+    # batch (full-length prompts, short decodes — the interference).
+    rng = np.random.default_rng(23)
+    work = []
+    for i in range(2 * requests):
+        if i % 2 == 0:
+            tenant = "interactive"
+            plen = int(rng.integers(1, block + 1))
+            n = interactive_new
+        else:
+            tenant = "batch"
+            plen = long_len - len(sys_prompt)
+            n = 2
+        prompt = sys_prompt + rng.integers(1, vocab, plen).tolist()
+        work.append((tenant, prompt, n))
+    # Warmup shapes: one per distinct prompt block count (the decode
+    # pool's import scatter compiles per shape; an unwarmed shape
+    # would bill one XLA compile to a handoff sample).
+    warm_prompts = [sys_prompt + [1] * 1, sys_prompt + [1] * (
+        long_len - len(sys_prompt))]
+
+    flight = obs.default_flight_recorder()
+
+    def run(tiered):
+        if tiered:
+            rs = ReplicaSet(factory, tiers={"prefill": 1, "decode": 1})
+            qos = QoSPolicy(
+                buckets={"interactive": (1e9, 1e9), "batch": (1e9, 1e9)},
+                weights={"interactive": 4.0, "batch": 1.0},
+                priorities={"interactive": 0, "batch": 2})
+            router = Router(rs, qos=qos)
+        else:
+            rs = ReplicaSet(factory, initial=2)
+            router = Router(rs)
+        for p in warm_prompts * 2:
+            router.result(router.submit(p, max_new_tokens=2),
+                          timeout_s=60.0)
+        for rep in rs.replicas.values():
+            rep.engine.metrics.reset()
+        router._handoff_s.clear()  # timed samples only (warmup compiled)
+        handoffs0, fails0 = router.handoffs, router.handoff_fails
+        kv_evs0 = len(flight.events(kind="kv_handoff"))
+
+        t0 = time.perf_counter()
+        rids = [(tenant,
+                 router.submit(prompt, max_new_tokens=n, tenant=tenant))
+                for tenant, prompt, n in work]
+        with ThreadPoolExecutor(max_workers=len(rids)) as ex:
+            futs = [ex.submit(router.result, rid, 180.0)
+                    for _, rid in rids]
+            results = [f.result() for f in futs]
+        dt = time.perf_counter() - t0
+
+        streams = [list(r.tokens) for r in results]
+        tps = sum(len(s) for s in streams) / dt
+        itl_interactive = [
+            r.itl_s_avg for (tenant, _, _), r in zip(work, results)
+            if tenant == "interactive" and r.itl_s_avg is not None]
+        if tiered:
+            decode_eng = rs.serving("decode")[0].engine
+            itl_engine_p99 = decode_eng.stats()["itl_s_p99"]
+        else:
+            itl_engine_p99 = max(
+                rep.engine.stats()["itl_s_p99"]
+                for rep in rs.replicas.values())
+        # Per-tenant goodput: min ratio across engines (finish-side
+        # ledgers live on whichever tier published the result).
+        ratios = {}
+        for rep in rs.replicas.values():
+            for t, row in rep.engine.costs.snapshot()["tenants"].items():
+                r = (row.get("goodput") or {}).get("ratio")
+                if t in ("interactive", "batch") and r is not None:
+                    ratios[t] = min(ratios.get(t, 1.0), r)
+        kv_evs = flight.events(kind="kv_handoff")[kv_evs0:]
+        out = {
+            "tps": tps,
+            "streams": streams,
+            "ok": all(r.status == "completed" for r in results),
+            "itl_interactive": itl_interactive,
+            "itl_engine_p99": itl_engine_p99,
+            "goodput_by_tenant": ratios,
+            "handoffs": router.handoffs - handoffs0,
+            "handoff_fails": router.handoff_fails - fails0,
+            "handoff_s": list(router._handoff_s),
+            "preemptions": router.preemptions,
+            "prefix_matched": sum(
+                1 for e in kv_evs if e.detail.get("matched", 0) >= 1),
+        }
+        router.close()
+        return out
+
+    def pctl(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else None
+
+    for attempt in range(attempts):
+        mono = run(False)
+        disagg = run(True)
+        mono_p99 = pctl(mono["itl_interactive"], 0.99)
+        dis_p99 = pctl(disagg["itl_interactive"], 0.99)
+        ratio = (disagg["itl_engine_p99"] / mono["itl_engine_p99"]
+                 if mono["itl_engine_p99"] else None)
+        if ratio is not None and ratio <= 1.0:
+            break
+    token_identical = mono["streams"] == disagg["streams"]
+    handoff_ms = [1000.0 * s for s in disagg["handoff_s"]]
+    hit_rate = (disagg["prefix_matched"] / disagg["handoffs"]
+                if disagg["handoffs"] else None)
+    rec = {
+        "mode": "fleet_disagg",
+        "replicas_mono": 2,
+        "tiers": {"prefill": 1, "decode": 1},
+        "requests": 2 * requests,
+        "kv_block_size": block,
+        "sys_prompt_blocks": 2,
+        "attempts_used": attempt + 1,
+        "tokens_per_sec_mono": mono["tps"],
+        "tokens_per_sec_disagg": disagg["tps"],
+        "itl_s_p99_interactive_mono": mono_p99,
+        "itl_s_p99_interactive_disagg": dis_p99,
+        "itl_s_p99_engine_mono": mono["itl_engine_p99"],
+        "itl_s_p99_engine_disagg": disagg["itl_engine_p99"],
+        "disagg_itl_p99_ratio": ratio,
+        "handoffs": disagg["handoffs"],
+        "handoff_fails": disagg["handoff_fails"],
+        "handoff_p50_ms": pctl(handoff_ms, 0.50),
+        "handoff_p99_ms": pctl(handoff_ms, 0.99),
+        "cross_tier_prefix_hit_rate": hit_rate,
+        "goodput_by_tenant": disagg["goodput_by_tenant"],
+        "goodput_floor_min_tenant": (
+            min(disagg["goodput_by_tenant"].values())
+            if disagg["goodput_by_tenant"] else None),
+        "preemptions": disagg["preemptions"],
+        "token_identical": token_identical,
+        "all_completed": mono["ok"] and disagg["ok"],
+    }
+    assert token_identical, (
+        "disaggregated token streams diverged from the monolithic fleet")
+    assert disagg["handoff_fails"] == 0, (
+        f"{disagg['handoff_fails']} handoffs degraded to local re-prefill")
+    return rec
+
+
 def main(argv=None) -> list:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--batches", type=int, nargs="+", default=[1, 8, 32])
@@ -1295,6 +1519,15 @@ def main(argv=None) -> list:
                              "router with exact per-tenant token "
                              "conservation and the exemplar-to-trace "
                              "join (appends to the fleet artifact)")
+    parser.add_argument("--disagg", action="store_true",
+                        help="run the disaggregated prefill/decode tier "
+                             "arm: tiered-vs-monolithic token identity "
+                             "across the KV-block handoff, decode-tier "
+                             "ITL p99 under long-prompt interference, "
+                             "handoff latency p50/p99, cross-tier "
+                             "prefix hits, and the per-tenant fair-"
+                             "share goodput floor (appends to the "
+                             "fleet artifact)")
     parser.add_argument("--fleet-out", type=str, default=None,
                         help="write the fleet arms as their own JSON "
                              "artifact (BENCH_FLEET.json)")
@@ -1402,6 +1635,14 @@ def main(argv=None) -> list:
             print(json.dumps(rec))
     if args.tenants:
         rec = bench_fleet_tenants(
+            compiled, args.serving_slots, args.prompt_len, args.new,
+            args.serving_requests,
+        )
+        fleet_records.append(rec)
+        records.append(rec)
+        print(json.dumps(rec))
+    if args.disagg:
+        rec = bench_fleet_disagg(
             compiled, args.serving_slots, args.prompt_len, args.new,
             args.serving_requests,
         )

@@ -531,6 +531,53 @@ def test_tenant_rules_gate_conservation_overhead_and_goodput():
         not in plain_by
 
 
+def test_disagg_rules_gate_identity_interference_and_handoff():
+    """The lm_bench --disagg row: token identity vs the monolithic
+    fleet is exact (handoff is a transport, not a resample), the
+    decode-tier ITL-interference ratio is an absolute ceiling at 1.0
+    (a fresh ratio worse than baseline but still under 1.0 passes —
+    the claim is 'tiering never lengthens the decode tail', not a
+    baseline diff), handoff p99 is an absolute ceiling, the cross-tier
+    prefix hit rate has the same 0.5 floor as the single-engine
+    --prefix row, and the worst tenant's goodput floor is absolute."""
+    base = [{"mode": "fleet_disagg", "disagg_itl_p99_ratio": 0.45,
+             "handoff_p50_ms": 3.0, "handoff_p99_ms": 12.0,
+             "cross_tier_prefix_hit_rate": 0.8,
+             "goodput_floor_min_tenant": 1.0,
+             "token_identical": True, "all_completed": True}]
+    drifted = bg.compare(base, [dict(
+        base[0], disagg_itl_p99_ratio=0.9, handoff_p99_ms=200.0,
+        cross_tier_prefix_hit_rate=0.55,
+        goodput_floor_min_tenant=0.3)], "fleet")
+    assert all(c["ok"] for c in drifted)
+    broken = bg.compare(base, [dict(
+        base[0], disagg_itl_p99_ratio=1.4, handoff_p99_ms=400.0,
+        cross_tier_prefix_hit_rate=0.2, goodput_floor_min_tenant=0.1,
+        token_identical=False)], "fleet")
+    failed = sorted(c["metric"] for c in broken if not c["ok"])
+    assert failed == ["cross_tier_prefix_hit_rate",
+                      "disagg_itl_p99_ratio",
+                      "goodput_floor_min_tenant",
+                      "handoff_p99_ms", "token_identical"]
+    by = _checks_by_metric(broken)
+    assert by[("fleet_disagg", "disagg_itl_p99_ratio")]["threshold"] == \
+        "must be <= 1.0"
+    assert by[("fleet_disagg", "handoff_p99_ms")]["threshold"] == \
+        "must be <= 250.0"
+    assert by[("fleet_disagg", "cross_tier_prefix_hit_rate")][
+        "threshold"] == "must be >= 0.5"
+    assert by[("fleet_disagg", "goodput_floor_min_tenant")][
+        "threshold"] == "must be >= 0.25"
+    # handoff_p50 is reported but not gated (p99 is the promise), and
+    # rows without the disagg metrics (the routed/kill/autoscale arms)
+    # are untouched by the new rules.
+    assert ("fleet_disagg", "handoff_p50_ms") not in by
+    plain = [{"mode": "fleet_routed_vs_bare", "routed_overhead_pct": 0.3,
+              "token_identical": True}]
+    plain_by = _checks_by_metric(bg.compare(plain, plain, "fleet"))
+    assert ("fleet_routed_vs_bare", "disagg_itl_p99_ratio") not in plain_by
+
+
 def test_spec_rules_gate_accept_identity_and_itl_ratio():
     """The lm_bench --spec row: token identity vs the unspeculated
     oracle is exact (the speculative contract), the accept rate is an
