@@ -54,6 +54,8 @@ RULE_NAMES = (
     "noisy_neighbor",
     "tier_imbalance",
     "handoff_slow",
+    "rollout_stuck",
+    "version_skew",
 )
 
 _PREDICATES = (">", "<")
@@ -182,6 +184,20 @@ def default_rules() -> List[AlertRule]:
                   ">", 0.5, kind="tier_imbalance", severity="warn"),
         AlertRule("handoff_slow", "fleet_handoff_seconds_p99",
                   ">", 0.25, kind="handoff_slow", severity="warn"),
+        # Live model delivery: a rollout that has sat in a non-idle
+        # phase for minutes is wedged — the canary is neither being
+        # judged good (promote) nor bad (rollback), usually a dead
+        # canary replica or a judge starved of traffic. Version skew
+        # means replicas are serving models >1 version apart after the
+        # promotion ripple should have converged — mixed-fleet answers
+        # are a correctness smell, not just an ops one. Both gauges are
+        # refreshed by the RolloutController's tick and sit at 0 on
+        # fleets without one, so the rules idle elsewhere.
+        AlertRule("rollout_stuck", "fleet_rollout_age_s",
+                  ">", 120.0, kind="rollout_stuck", severity="warn"),
+        AlertRule("version_skew", "fleet_version_skew",
+                  ">", 1.0, kind="version_skew", severity="warn",
+                  burn=2),
     ]
 
 

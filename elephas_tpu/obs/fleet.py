@@ -471,7 +471,7 @@ class FleetAggregator:
         # serve them, and their absence must not fail the whole poll —
         # each is fetched in its own tolerant attempt.
         for route in ("/load", "/slo", "/replicas", "/incidents",
-                      "/trials", "/tenants", "/tiers"):
+                      "/trials", "/tenants", "/tiers", "/rollout"):
             try:
                 scrape[route[1:]] = json.loads(
                     self.fetch(f"{entry.url}{route}", self.timeout))
@@ -557,6 +557,12 @@ class FleetAggregator:
         per_tiers = {e.name: e.scrape["tiers"]
                      for e in entries
                      if e.scrape.get("tiers", {}).get("tiers")}
+        # Live-delivery plane (/rollout): only routers with an attached
+        # RolloutController contribute (an active plane) — a per-router
+        # document like /tiers, never summed.
+        per_rollout = {e.name: e.scrape["rollout"]
+                       for e in entries
+                       if e.scrape.get("rollout", {}).get("active")}
         from elephas_tpu.obs.tenancy import merge_tenant_docs
         merged_tenants = merge_tenant_docs(
             [per_tenants[k] for k in sorted(per_tenants)])
@@ -580,4 +586,5 @@ class FleetAggregator:
             "per_tenants": per_tenants,
             "tenants": merged_tenants,
             "tiers": per_tiers,
+            "rollout": per_rollout,
         }

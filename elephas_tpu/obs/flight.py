@@ -110,6 +110,23 @@ KINDS = (
     # and handoff-latency p99 breaches
     "tier_imbalance",
     "handoff_slow",
+    # live model delivery (rollout/): an engine atomically swapped to
+    # newly-pulled serving weights at a decode-step boundary
+    # (weight_swap); a subscriber's PS pull failed and the engine kept
+    # serving its current weights (weight_pull_fail); the controller
+    # promoted a baked canary version fleet-wide (rollout_promote) or
+    # rolled the canary back to the pinned prior version
+    # (rollout_rollback)
+    "weight_swap",
+    "weight_pull_fail",
+    "rollout_promote",
+    "rollout_rollback",
+    # rollout alert-plane kinds (obs/alerts.py): a rollout has sat in a
+    # non-idle phase past its stuck threshold (rollout_stuck); replicas
+    # are serving versions >1 apart past the skew grace window
+    # (version_skew)
+    "rollout_stuck",
+    "version_skew",
 )
 
 

@@ -95,6 +95,7 @@ ROUTES = (
     "/trials",
     "/tenants",
     "/tiers",
+    "/rollout",
 )
 
 
@@ -162,6 +163,11 @@ class OpsServer:
         per-tier membership/load/KV pressure, KV-handoff latency and
         failure counts, and the QoS policy card for disaggregated
         prefill/decode serving); empty topology when unset.
+    rollout_fn: the ``/rollout`` payload (a ``RolloutController.doc``
+        — live-delivery state machine phase, per-replica served
+        versions with the canary flagged, the pinned/candidate
+        versions, and the rollout event history + digest); idle plane
+        when unset.
     """
 
     def __init__(self, port: int = 0, host: Optional[str] = None,
@@ -182,7 +188,8 @@ class OpsServer:
                  incidents_fn: Optional[Callable[[], Dict]] = None,
                  trials_fn: Optional[Callable[[], Dict]] = None,
                  tenants_fn: Optional[Callable[[], Dict]] = None,
-                 tiers_fn: Optional[Callable[[], Dict]] = None):
+                 tiers_fn: Optional[Callable[[], Dict]] = None,
+                 rollout_fn: Optional[Callable[[], Dict]] = None):
         self._requested_port = port
         self.host = host if host is not None else _default_bind_host()
         self._registry = registry
@@ -207,6 +214,7 @@ class OpsServer:
         self._trials_fn = trials_fn
         self._tenants_fn = tenants_fn
         self._tiers_fn = tiers_fn
+        self._rollout_fn = rollout_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_wall = None
@@ -235,6 +243,7 @@ class OpsServer:
         self._add_route("/trials", self._h_trials)
         self._add_route("/tenants", self._h_tenants)
         self._add_route("/tiers", self._h_tiers)
+        self._add_route("/rollout", self._h_rollout)
 
     def _add_route(self, path: str, handler: Callable) -> None:
         self._routes[path] = handler
@@ -415,6 +424,14 @@ class OpsServer:
                      "handoffs": {"count": 0, "fails": 0,
                                   "p50_ms": None, "p99_ms": None},
                      "preemptions": 0, "qos": None}
+
+    def _h_rollout(self, query):
+        if self._rollout_fn is not None:
+            return 200, self._rollout_fn()
+        return 200, {"active": False, "phase": "idle",
+                     "approved_version": None, "candidate_version": None,
+                     "canary": None, "versions": {}, "skew": 0,
+                     "events": [], "digest": None}
 
     def start(self) -> "OpsServer":
         if self._httpd is not None:

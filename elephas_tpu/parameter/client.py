@@ -170,6 +170,24 @@ class ParameterServerUnavailable(ConnectionError):
     """The parameter server could not be reached after retries."""
 
 
+class VersionUnavailable(RuntimeError):
+    """A pinned pull asked for a version the server can no longer serve.
+
+    The version-pinning plane (``rollout/``) reads historical snapshots
+    out of the PS's WAL; the WAL keeps a bounded window, so a pin that
+    outlived it is a *definitive* application answer — re-sending the
+    same request cannot succeed, and callers (the rollout controller's
+    rollback path) must pick a different pin, not retry."""
+
+    def __init__(self, address: str, version: int):
+        self.address = address
+        self.version = int(version)
+        super().__init__(
+            f"parameter server at {address} cannot serve pinned version "
+            f"{version} (not the live buffer and outside its WAL window)"
+        )
+
+
 class StaleDeltaRejected(RuntimeError):
     """The PS refused a pushed delta: staler than its admission bound.
 
@@ -467,6 +485,11 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
                 sp.note(codec="pickle", payload_bytes=len(body))
             return wire.decode_pickle(body)
 
+    def known_version(self) -> Optional[int]:
+        """Version of the last full-body pull this client cached (None
+        before any pull) — the subscription plane's position probe."""
+        return self._pull_cache.known_version()
+
     def update_parameters(self, delta) -> None:
         with _ps_span("push", "http") as sp:
             delta = jax.device_get(delta)
@@ -502,6 +525,35 @@ class HttpClient(_WireBarrierMixin, BaseParameterClient):
             # (normally empty) 200 body — surface it as the exception
             # the comms pipeline's ratchet acts on.
             _raise_if_rejected(body, self.master_url)
+
+    def get_parameters_pinned(self, version: int):
+        """Snapshot read of one EXACT historical version (WAL-backed).
+
+        Bypasses the version-gated pull cache entirely — a pinned read
+        is a point lookup for rollback/A-B, never "the latest", so it
+        must not poison the cache's notion of the current position.
+        Raises ``VersionUnavailable`` when the server no longer holds
+        that version (live buffer moved on AND the WAL pruned it)."""
+        with _ps_span("pull", "http") as sp:
+            headers = {"X-Elephas-Codec": "packed",
+                       "X-Elephas-Pinned": str(int(version))}
+            try:
+                body = self._get("/parameters", "get_parameters_pinned",
+                                 headers=headers)
+            except RuntimeError as exc:
+                if "HTTP 404" in str(exc):
+                    raise VersionUnavailable(self.master_url,
+                                             version) from exc
+                raise
+            out = wire.decode(body)
+            if out.version != int(version):
+                raise RuntimeError(
+                    f"pinned pull for version {version} answered with "
+                    f"version {out.version} (protocol violation)")
+            if sp:
+                sp.note(codec="packed", payload_bytes=len(body),
+                        pinned=int(version))
+            return out.tree
 
     def health(self) -> bool:
         """One non-retried probe of ``GET /health``, bounded end-to-end by
@@ -683,6 +735,10 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
                 sp.note(codec="packed", payload_bytes=len(reply))
             return out.tree
 
+    def known_version(self) -> Optional[int]:
+        """See ``HttpClient.known_version``."""
+        return self._pull_cache.known_version()
+
     def update_parameters(self, delta) -> None:
         with _ps_span("push", "socket") as sp:
             delta = jax.device_get(delta)
@@ -715,6 +771,31 @@ class SocketClient(_WireBarrierMixin, BaseParameterClient):
             if sp:
                 sp.note(codec=codec, payload_bytes=nbytes,
                         quantize=self.push_quantize)
+
+    def get_parameters_pinned(self, version: int):
+        """Snapshot read of one EXACT historical version (WAL-backed,
+        frame kind ``"V"``). Bypasses the pull cache — see
+        ``HttpClient.get_parameters_pinned``. A ``None`` reply is the
+        server's typed "don't have it" answer → ``VersionUnavailable``."""
+        with _ps_span("pull", "socket") as sp, self._lock:
+            reply = self._roundtrip(("V", int(version)),
+                                    "get_parameters_pinned",
+                                    idempotent=True)
+            if reply is None:
+                raise VersionUnavailable(self.master_url, version)
+            if not isinstance(reply, (bytes, bytearray, memoryview)):
+                raise RuntimeError(
+                    "parameter server sent a non-packed reply to a pinned "
+                    "pull — is it a pre-rollout server?")
+            out = wire.decode(reply)
+            if out.version != int(version):
+                raise RuntimeError(
+                    f"pinned pull for version {version} answered with "
+                    f"version {out.version} (protocol violation)")
+            if sp:
+                sp.note(codec="packed", payload_bytes=len(reply),
+                        pinned=int(version))
+            return out.tree
 
     def health(self) -> bool:
         """Liveness probe: a barrier *count* on a FRESH connection.

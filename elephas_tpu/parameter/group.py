@@ -496,6 +496,41 @@ class ShardedParameterClient(BaseParameterClient):
             subs = self._fanout(lambda s, c: c.get_parameters())
         return self._plan.merge(subs)
 
+    def known_version(self) -> Optional[int]:
+        """The group's pulled position: max over the sub-clients' cached
+        versions (None before any pull). Shard version lines advance in
+        lockstep under full-tree pushes — every ``update_parameters``
+        scatters one slice to every shard — so the max IS the group
+        version; a lagging shard is surfaced by the next ``pull``'s
+        per-shard version check, not hidden by a min()."""
+        versions = [
+            c.known_version() for c in list(self._clients.values())
+            if hasattr(c, "known_version")
+        ]
+        versions = [v for v in versions if v is not None]
+        return max(versions) if versions else None
+
+    def pull(self, version: Optional[int] = None):
+        """``(version, tree)`` — the subscription plane's read.
+
+        ``version=None`` is the live read: the normal version-gated
+        gather (steady state costs K not-modified frames) plus the
+        group position the reply landed at. ``version=`` is the PINNED
+        read: every shard answers from its live buffer or its WAL
+        history at exactly that version (``get_parameters_pinned``), so
+        rollback and A/B reads cannot race ongoing training pushes.
+        Raises ``VersionUnavailable`` when any shard has pruned the pin.
+        """
+        if version is not None:
+            pin = int(version)
+            with obs.default_tracer().span(
+                    "ps/gather_pinned", shards=self._plan.k):
+                subs = self._fanout(
+                    lambda s, c: c.get_parameters_pinned(pin))
+            return pin, self._plan.merge(subs)
+        tree = self.get_parameters()
+        return self.known_version(), tree
+
     def update_parameters(self, delta) -> None:
         # Admission is per shard: each member judges its slice against
         # its own version line, so a StaleDeltaRejected from any shard
@@ -637,6 +672,7 @@ class ShardGroup(BaseParameterServer):
                  lock: bool = True, device=None, host: Optional[str] = None,
                  granularity: str = "tree",
                  auth_key: Optional[bytes] = None, wal_every: int = 1,
+                 wal_keep: int = 3,
                  heartbeat_timeout: Optional[float] = None,
                  ops_port: Optional[int] = None,
                  suspect_after: float = 0.5,
@@ -688,6 +724,7 @@ class ShardGroup(BaseParameterServer):
                 mode, self.plan.shard_tree(params, shard), lock=lock,
                 port=0, device=device, host=host, granularity=granularity,
                 auth_key=auth_key, wal_dir=wal_dir, wal_every=wal_every,
+                wal_keep=wal_keep,
                 heartbeat_timeout=heartbeat_timeout, ops_port=ops,
                 role=role,
                 shard_info={"digest": self.plan.digest, "shard": shard,

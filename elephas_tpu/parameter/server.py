@@ -285,7 +285,8 @@ def _make_detector(heartbeat_timeout: Optional[float]):
     return FailureDetector(suspect_after=_heartbeat_timeout(heartbeat_timeout))
 
 
-def _attach_wal(buffer: ParameterBuffer, wal_dir: str, wal_every: int):
+def _attach_wal(buffer: ParameterBuffer, wal_dir: str, wal_every: int,
+                wal_keep: int = 3):
     """Warm-restart ``buffer`` from the newest durable WAL snapshot and
     return the ``WalWriter`` that keeps the log moving.
 
@@ -295,7 +296,7 @@ def _attach_wal(buffer: ParameterBuffer, wal_dir: str, wal_every: int):
     from elephas_tpu.checkpoint.checkpoint import NoCheckpointError
     from elephas_tpu.resilience.wal import SnapshotWAL, WalWriter
 
-    wal = SnapshotWAL(wal_dir)
+    wal = SnapshotWAL(wal_dir, keep=wal_keep)
     try:
         version, tree = wal.restore_latest()
     except NoCheckpointError:
@@ -350,6 +351,24 @@ class _SnapshotCache:
             entry = (version, payload)
             self._entries[codec] = entry
             return entry
+
+
+def _pinned_payload(cache: _SnapshotCache, wal_writer, version: int):
+    """Payload for a version-PINNED pull (rollout plane): the live
+    snapshot when the pin IS the buffer's current version, else the
+    durable WAL frame at exactly that version, else ``None`` — the
+    typed "can no longer serve it" answer the client surfaces as
+    ``VersionUnavailable``. Pinned reads deliberately skip the
+    not-modified negotiation: the caller wants THESE bytes regardless
+    of its cached position (rollback must not race live pushes)."""
+    live, frames = cache.frames("packed")
+    if live == version:
+        return frames
+    if wal_writer is not None:
+        raw = wal_writer.wal.read_version(version)
+        if raw is not None:
+            return socket_utils.RawPayload([raw])
+    return None
 
 
 def _dump_flight_on_kill(boot: str, wal_dir: Optional[str]) -> Optional[str]:
@@ -577,6 +596,7 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         auth_key: Optional[bytes] = None,
         wal_dir: Optional[str] = None,
         wal_every: int = 1,
+        wal_keep: int = 3,
         heartbeat_timeout: Optional[float] = None,
         tracer=None,
         ops_port: Optional[int] = None,
@@ -637,7 +657,8 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
         self.boot = _new_boot_id()
         self.detector = _make_detector(heartbeat_timeout)
         self.wal_writer = (
-            _attach_wal(self.buffer, wal_dir, wal_every) if wal_dir else None
+            _attach_wal(self.buffer, wal_dir, wal_every, wal_keep=wal_keep)
+            if wal_dir else None
         )
         self.tracer = tracer
         self.ops_port = ops_port
@@ -756,6 +777,24 @@ class HttpServer(_ObservableServerMixin, BaseParameterServer):
                         # encoded snapshot comes from the version-gated
                         # cache — the buffer lock is never held across
                         # serialization.
+                        pinned = self.headers.get("X-Elephas-Pinned")
+                        if pinned is not None:
+                            try:
+                                pin = int(pinned)
+                            except ValueError:
+                                self.send_error(400, "bad pinned version")
+                                return
+                            payload = _pinned_payload(cache, wal_writer, pin)
+                            if payload is None:
+                                self.send_error(
+                                    404, "pinned version unavailable")
+                                return
+                            bytes_tx.inc(payload.nbytes)
+                            self._reply(
+                                payload,
+                                content_type="application/octet-stream",
+                                version=pin)
+                            return
                         codec = "packed" if self.headers.get(
                             "X-Elephas-Codec") == "packed" else "pickle"
                         known = self.headers.get("X-Elephas-Version")
@@ -1072,6 +1111,12 @@ class _SocketHandler(socketserver.BaseRequestHandler):
                 elif kind == "i":  # shard-group handshake (live boot)
                     reply(dict(shard_info, boot=boot)
                           if shard_info is not None else None)
+                elif kind == "V":  # version-PINNED pull (rollout plane)
+                    with obs.activate(ctx), tracer_of().span(
+                            "ps/handle_pull", boot=boot,
+                            transport="socket"):
+                        reply(_pinned_payload(cache, wal_writer,
+                                              int(payload)))
                 else:
                     break
         except (ConnectionError, OSError):
@@ -1143,6 +1188,7 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         auth_key: Optional[bytes] = None,
         wal_dir: Optional[str] = None,
         wal_every: int = 1,
+        wal_keep: int = 3,
         heartbeat_timeout: Optional[float] = None,
         tracer=None,
         ops_port: Optional[int] = None,
@@ -1173,7 +1219,8 @@ class SocketServer(_ObservableServerMixin, BaseParameterServer):
         self.boot = _new_boot_id()
         self.detector = _make_detector(heartbeat_timeout)
         self.wal_writer = (
-            _attach_wal(self.buffer, wal_dir, wal_every) if wal_dir else None
+            _attach_wal(self.buffer, wal_dir, wal_every, wal_keep=wal_keep)
+            if wal_dir else None
         )
         self.tracer = tracer
         self.ops_port = ops_port
@@ -1260,6 +1307,7 @@ def make_server(
     auth_key: Optional[bytes] = None,
     wal_dir: Optional[str] = None,
     wal_every: int = 1,
+    wal_keep: int = 3,
     heartbeat_timeout: Optional[float] = None,
     tracer=None,
     ops_port: Optional[int] = None,
@@ -1317,6 +1365,7 @@ def make_server(
         return HttpServer(params, lock=lock, port=port, device=device, host=host,
                           granularity=granularity, auth_key=auth_key,
                           wal_dir=wal_dir, wal_every=wal_every,
+                          wal_keep=wal_keep,
                           heartbeat_timeout=heartbeat_timeout,
                           tracer=tracer, ops_port=ops_port,
                           role=role, shard_info=shard_info,
@@ -1327,6 +1376,7 @@ def make_server(
         return SocketServer(params, lock=lock, port=port, device=device, host=host,
                             granularity=granularity, auth_key=auth_key,
                             wal_dir=wal_dir, wal_every=wal_every,
+                            wal_keep=wal_keep,
                             heartbeat_timeout=heartbeat_timeout,
                             tracer=tracer, ops_port=ops_port,
                             role=role, shard_info=shard_info,

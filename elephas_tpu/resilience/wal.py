@@ -93,6 +93,20 @@ class SnapshotWAL:
             return self.versions()
         return [v for v in self.versions() if v > version]
 
+    def read_version(self, version: int) -> Optional[bytes]:
+        """Raw packed frame bytes of the durable snapshot at exactly
+        ``version`` — the pinned-read plane's data source (rollout
+        rollback / A-B reads that must not race live pushes). ``None``
+        when that version is not on disk: pruned past ``keep``, or never
+        snapshotted (with ``wal_every > 1`` the durable counter is
+        sparse). Each ``.epk`` file is exactly one packed wire frame
+        with ``ver`` in its header, so servers relay the bytes verbatim
+        and the client's normal decode path validates them."""
+        try:
+            return self._path(version).read_bytes()
+        except OSError:
+            return None
+
     def append(self, tree, version: int) -> Path:
         """Durably persist ``tree`` tagged with ``version``.
 

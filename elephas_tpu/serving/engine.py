@@ -325,6 +325,12 @@ class InferenceEngine:
         # ledger, so real-traffic SLO accounting is canary-blind.
         self._canary_ids: set = set()
         self.canary = None  # CanaryDriver, attached on demand
+        # Live model delivery (rollout/): the PS version the serving
+        # params carry (None = the construction-time tree, no delivery
+        # yet) and the WeightSubscriber whose on_step hook runs at
+        # every decode-step boundary under _step_lock.
+        self.model_version: Optional[int] = None
+        self.subscriber = None
 
     def _make_jits(self, in_shardings=None, out_shardings=None):
         """(Re)build the two compiled entry points. With shardings the
@@ -839,6 +845,8 @@ class InferenceEngine:
             if not self._halted and self._step_lock.acquire(blocking=False):
                 try:
                     finished = [] if self._halted else self.scheduler.step()
+                    if not self._halted:
+                        self._on_step_boundary()
                 finally:
                     self._step_lock.release()
                 self._publish(finished)
@@ -965,8 +973,36 @@ class InferenceEngine:
             if self._halted:
                 return []
             finished = self.scheduler.step()
+            self._on_step_boundary()
         self._publish(finished)
         return finished
+
+    def _on_step_boundary(self) -> None:
+        """The subscription plane's atomic swap point. Runs under
+        ``_step_lock`` after every scheduler step — no program is
+        mid-dispatch and a speculative window (one scheduler step is
+        one draft+verify window) can never span it — so a weight swap
+        here is invisible to in-flight token streams except as "the
+        next token came from the new model"."""
+        sub = self.subscriber
+        if sub is not None:
+            sub.on_step(self)
+
+    def install_weights(self, tree, version: Optional[int] = None) -> None:
+        """Swap the serving params in place (the rollout plane's write
+        seam — callers hold ``_step_lock`` via the subscriber hook, or
+        own the engine exclusively). The pulled leaves are re-nested
+        into the CURRENT params' container structure: jax tree ops and
+        the wire codec rebuild dicts in sorted-key order, and pinning
+        the treedef keeps the compiled programs' input structure stable
+        — a swap must never retrace. ``model_version`` takes the PS
+        version the tree was pulled at."""
+        self.params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self.params),
+            jax.tree_util.tree_leaves(tree),
+        )
+        if version is not None:
+            self.model_version = int(version)  # host-ok: PS version, plain int
 
     def result(
         self, req_id: int, timeout_s: Optional[float] = None
@@ -983,6 +1019,8 @@ class InferenceEngine:
                 # No server thread mid-step: advance the world ourselves.
                 try:
                     finished = [] if self._halted else self.scheduler.step()
+                    if not self._halted:
+                        self._on_step_boundary()
                 finally:
                     self._step_lock.release()
                 self._publish(finished)
@@ -1030,6 +1068,7 @@ class InferenceEngine:
     def stats(self) -> dict:
         out = {
             **self.metrics.summary(),
+            "model_version": self.model_version,
             "prefill_traces": self._prefill_traces,
             "decode_traces": self._decode_traces,
             "pool_admitted_total": self.pool.admitted_total,

@@ -148,6 +148,11 @@ class Replica:
         #: Router bookkeeping: canary-flagged drains restart when the
         #: drain completes; autoscaler drains stay down.
         self.pending_restart = False
+        #: Set by the ``RolloutController`` while this slot is the
+        #: weight-rollout canary (bake in progress) — fleet_top stars
+        #: the VERSION cell. Distinct from ``CanaryDriver`` (request
+        #: probing) above.
+        self.rollout_canary = False
         self.scale_down = False
         #: Canary failure count already acted on (drain-and-restart
         #: fires on *fresh* failures, not the lifetime total).
@@ -368,9 +373,12 @@ class Replica:
             "canary_probes": 0,
             "canary_failures": 0,
             "ops_port": None,
+            "model_version": None,
+            "rollout_canary": self.rollout_canary,
         }
         if self.engine is None:
             return doc
+        doc["model_version"] = self.engine.model_version
         if self.state != DEAD:
             doc["load_score"] = self.load_score()
             doc["queue_depth"] = len(self.engine.queue)

@@ -153,6 +153,9 @@ class Router:
         self._sessions: Dict[str, str] = {}
         self._affinity: Dict[str, Dict[str, int]] = {}
         self.ops = None
+        #: ``RolloutController`` once attached — feeds the ops
+        #: ``/rollout`` route and is ticked alongside the router.
+        self.rollout = None
 
         # Plain-int mirrors readable without a registry scrape; the
         # counters are the dashboard surface.
@@ -669,6 +672,8 @@ class Router:
                     victim.scale_down = True
                     victim.drain(reason="scale_down")
                 actions["scale"] = decision
+        if self.rollout is not None:
+            actions["rollout"] = self.rollout.tick(now)
         return actions
 
     # -- introspection -----------------------------------------------------
@@ -787,8 +792,25 @@ class Router:
             replicas_fn=self.replicas_doc,
             tenants_fn=self._tenants_doc,
             tiers_fn=self.tiers_doc,
+            rollout_fn=self._rollout_doc,
         ).start()
         return self.ops
+
+    def attach_rollout(self, controller) -> None:
+        """Adopt a ``RolloutController`` for this fleet: its ``doc()``
+        serves the ops ``/rollout`` route (federated by the fleet
+        aggregator), and each ``Router.tick`` drives one controller
+        tick so delivery policy shares the router's actuation cadence.
+        The controller stays usable standalone (``start_ticker``)."""
+        self.rollout = controller
+
+    def _rollout_doc(self) -> Dict[str, Any]:
+        if self.rollout is None:
+            return {"active": False, "phase": "idle",
+                    "approved_version": None, "candidate_version": None,
+                    "canary": None, "versions": {}, "skew": 0,
+                    "events": [], "digest": None}
+        return self.rollout.doc()
 
     def _tenants_doc(self) -> Dict[str, Any]:
         """Fleet-wide ``/tenants``: tenant-wise union of every serving

@@ -155,7 +155,8 @@ class DraftModelSource(DraftSource):
 
     kind = "model"
 
-    def __init__(self, module, client, refresh_every: int = 1):
+    def __init__(self, module, client, refresh_every: int = 1,
+                 subscribed: bool = False):
         if refresh_every < 1:
             raise ValueError(
                 f"refresh_every must be >= 1, got {refresh_every}"
@@ -163,6 +164,12 @@ class DraftModelSource(DraftSource):
         self._raw_module = module
         self.client = client
         self.refresh_every = int(refresh_every)  # host-ok: constructor arg
+        # subscribed=True hands the pull cadence to the engine's
+        # WeightSubscriber: ``params()`` never self-polls (beyond the
+        # one cold-start pull) and ``refresh()`` is driven at the
+        # subscriber's step cadence — ONE version-gated poll per window
+        # refreshes target and draft instead of two.
+        self.subscribed = bool(subscribed)
         self.module = None
         self._engine = None
         self._cached = None
@@ -189,6 +196,17 @@ class DraftModelSource(DraftSource):
         self._engine = engine
 
     def params(self):
+        if self.subscribed:
+            # Subscriber-owned cadence: serve the cache; the engine's
+            # WeightSubscriber calls refresh() between decode windows
+            # (the draft rides the target's poll — no double-polling
+            # the PS group). Cold start still pulls once: a spec
+            # window must never run on a None tree.
+            self._windows += 1
+            if self._cached is None:
+                self._cached = self.client.get_parameters()
+                self.pulls += 1
+            return self._cached
         take = (self._cached is None
                 or self._windows % self.refresh_every == 0)
         self._windows += 1
@@ -197,6 +215,16 @@ class DraftModelSource(DraftSource):
             self._cached = tree
             self.pulls += 1
         return self._cached
+
+    def refresh(self) -> None:
+        """Re-pull the draft tree NOW — the ``WeightSubscriber``'s hook,
+        called at its own (version-gated) cadence right after the target
+        pull, so one subscriber tick refreshes both models. Runs at a
+        decode-step boundary, never mid-verify (the hook fires under the
+        engine's step lock). A pull failure propagates to the caller,
+        which degrades exactly like a failed target pull."""
+        self._cached = self.client.get_parameters()
+        self.pulls += 1
 
 
 class SpeculativeDecoder:

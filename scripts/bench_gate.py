@@ -326,6 +326,26 @@ RULES: Dict[str, Tuple[Tuple[str, ...], List[Tuple[str, str, float]]]] = {
             ("handoff_p99_ms", "limit", 250.0),
             ("cross_tier_prefix_hit_rate", "floor", 0.5),
             ("goodput_floor_min_tenant", "floor", 0.25),
+            # Live-model-delivery row (--rollout). token_identical
+            # reuses the equal-rule above for the zero-delta phase: a
+            # mid-stream swap to byte-identical weights must not change
+            # one emitted token vs the no-swap oracle — the swap seam
+            # is atomic or it is broken. The swap tax is a ratio of ITL
+            # p99 with a per-step version-gated subscriber against the
+            # no-subscriber fleet: steady state is K not-modified
+            # frames, so anything past 1.5x means the gate leaked full
+            # transfers onto the serving path. rollback_served_stale
+            # counts non-canary replicas ever OBSERVED at the poisoned
+            # version during the forced-rollback phase — the canary
+            # blast-radius proof, held at exactly zero. The goodput
+            # floor spans the whole arc: a live trainer pushing through
+            # canary, promote AND rollback must not cost the fleet its
+            # worst-objective attainment.
+            ("swap_itl_p99_ratio", "limit", 1.5),
+            ("rollback_served_stale", "equal", 0.0),
+            ("rollout_goodput_ratio", "floor", 0.50),
+            ("rollout_promoted", "equal", 0.0),
+            ("rollout_rolled_back", "equal", 0.0),
         ],
     ),
 }

@@ -182,9 +182,16 @@ def _replica_cells(rid: str, card: dict, proc_status: str) -> str:
     tier = str(card.get("tier") or "-") if alive else "-"
     boot = str(card.get("boot", "-")) if alive else "-"
     inflt = str(card.get("in_flight", "-")) if alive else "-"
+    # Served weight version (rollout plane). '-' for stale/dead procs
+    # and dead replicas (no engine → no version); a '*' suffix marks
+    # the rollout canary mid-bake.
+    ver = card.get("model_version")
+    version = str(ver) if alive and ver is not None else "-"
+    if alive and card.get("rollout_canary"):
+        version += "*"
     return (f"{rid:<9} {state:<9} {tier:<8} {boot:>4} "
             f"{num(card.get('load_score')):>6} {rate:>8} {inflt:>6} "
-            f"{num(card.get('burn_worst')):>6}")
+            f"{num(card.get('burn_worst')):>6} {version:>8}")
 
 
 def render(snap: dict) -> str:
@@ -243,7 +250,8 @@ def render(snap: dict) -> str:
                      f"requeues={rstat('requeues')} "
                      f"sessions={rstat('sessions')}")
         lines.append(f"  {'REPLICA':<9} {'STATE':<9} {'TIER':<8} {'BOOT':>4} "
-                     f"{'LOAD':>6} {'AFF HIT':>8} {'INFLT':>6} {'BURN':>6}")
+                     f"{'LOAD':>6} {'AFF HIT':>8} {'INFLT':>6} {'BURN':>6} "
+                     f"{'VERSION':>8}")
         for rid, card in sorted((doc.get("replicas") or {}).items()):
             lines.append("  " + _replica_cells(rid, card, proc_status))
     for proc, doc in sorted((snap.get("tiers") or {}).items()):
@@ -287,6 +295,43 @@ def render(snap: dict) -> str:
                     f"{(f'{100.0 * fill:.0f}%' if alive and fill is not None else '-'):>7} "
                     f"{qcell('vtime', '{:.1f}'):>9} {qcell('admitted'):>6} "
                     f"{qcell('throttled'):>6} {qcell('preempted'):>7}")
+    for proc, doc in sorted((snap.get("rollout") or {}).items()):
+        # Live-model-delivery board (/rollout): the canary state
+        # machine's phase, the approved/candidate versions, per-replica
+        # served versions, and the tail of the replay-stable event log.
+        # The aggregator only federates ACTIVE docs, so a fleet without
+        # a RolloutController simply has no board; stale/dead procs are
+        # dropped by the same active-filter (their scrape is empty).
+        proc_status = (snap["processes"].get(proc) or {}).get("status", "?")
+        alive = proc_status == "alive"
+
+        def rcell(key):
+            v = doc.get(key)
+            return v if alive and v is not None else "-"
+
+        versions = doc.get("versions") or {}
+        vcells = "  ".join(
+            f"{rid}={'-' if v is None else v}"
+            for rid, v in sorted(versions.items()))
+        lines.append("")
+        lines.append(
+            f"rollout via {proc}: phase={rcell('phase')} "
+            f"approved={rcell('approved_version')} "
+            f"candidate={rcell('candidate_version')} "
+            f"canary={rcell('canary')} skew={rcell('skew')} "
+            f"age={doc.get('age_s', 0):.0f}s "
+            f"promoted={rcell('rollouts')} rolled_back={rcell('rollbacks')}")
+        if vcells:
+            lines.append(f"  versions: {vcells}")
+        events = doc.get("events") or []
+        for ev in events[-5:]:
+            extras = " ".join(
+                f"{k}={ev[k]}" for k in ("version", "replica", "tier", "to")
+                if ev.get(k) is not None)
+            lines.append(f"  #{ev.get('seq', '?'):<4} "
+                         f"{ev.get('kind', '?'):<20} {extras}")
+        if doc.get("digest"):
+            lines.append(f"  digest: {doc['digest']}")
     for proc, doc in sorted((snap.get("per_tenants") or {}).items()):
         # Per-tenant cost board (obs/tenancy.py). Untagged requests
         # already bill as tenant "default" in the ledger, so they show

@@ -38,6 +38,11 @@ artifact (``--out BENCH_DECODE.json``):
   across the KV-block handoff, the decode-tier ITL p99 ratio under
   long-prompt interference, handoff latency p50/p99, the cross-tier
   prefix hit rate, and the per-tenant fair-share goodput floor.
+- ``{"mode": "fleet_rollout", ...}`` (``--rollout``, appends to the
+  fleet artifact) — live model delivery: mid-stream zero-delta swap
+  identity + swap-tax ITL ratio, steady-state subscription wire cost,
+  and a full canary arc (live trainer push → promote, then a forced
+  rollback with zero non-canary exposure to the poisoned version).
 
 Importable (and runnable with tiny defaults) without a TPU — tier-1
 collects it; real numbers come from the dev chip.
@@ -1457,6 +1462,231 @@ def bench_fleet_disagg(compiled, max_slots: int, prompt_len: int,
     return rec
 
 
+def bench_fleet_rollout(compiled, max_slots: int, prompt_len: int,
+                        new_tokens: int, requests: int) -> dict:
+    """Live-model-delivery arm (``--rollout``): a live trainer pushes
+    into a PS group while the fleet serves, and the rollout plane
+    delivers. Three phases on one row:
+
+    1. **Swap tax + identity** — two 2-replica fleets run the standard
+       seeded workload: one bare, one with a per-step version-gated
+       ``WeightSubscriber`` (follow mode) on every engine while a
+       trainer thread pushes ZERO deltas. The swaps are real (version
+       changes, ``install_weights`` fires mid-stream) but the weights
+       are byte-identical, so the token streams must equal the bare
+       fleet's — the atomic-swap proof the gate holds with the
+       ``token_identical`` equal-rule. The ITL p99 ratio between the
+       arms is the swap tax (``swap_itl_p99_ratio``, ceiling 1.5), and
+       a post-push quiet window measures the steady-state wire cost of
+       the subscription (not-modified frames only).
+    2. **Canary promote** — a 3-replica fleet under a
+       ``RolloutController`` (goodput judge, short bake): one real
+       delta push must reach every replica through the canary arc with
+       zero dropped requests while traffic flows.
+    3. **Forced rollback** — a second push with the judge pinned to
+       "bad": the canary must return to the approved version, and
+       ``rollback_served_stale`` counts non-canary replicas ever
+       OBSERVED at the poisoned version — committed at exactly 0 (the
+       blast-radius proof).
+
+    The whole-arc ``rollout_goodput_ratio`` (router ledger, lifetime
+    worst objective) carries the gate floor: delivery must not cost the
+    fleet its attainment. The controller's replay-stable event digest
+    rides the row for the incident-timeline cross-check.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from elephas_tpu.parameter import ShardGroup
+    from elephas_tpu.parameter.server import _ps_counters
+    from elephas_tpu.rollout import (RolloutController, WeightSubscriber,
+                                     goodput_judge)
+    from elephas_tpu.serving import ReplicaSet, Router
+
+    vocab = compiled.module.vocab_size
+    factory = _engine_factory(compiled, max_slots, prompt_len, new_tokens,
+                              2 * max(requests, 1) + 8)
+    zero_delta = jax.tree_util.tree_map(
+        lambda a: np.zeros_like(np.asarray(a)), compiled.params)
+    _, bytes_tx, _ = _ps_counters("socket")
+
+    # -- phase 1: swap tax + mid-stream token identity ------------------
+    def run_arm(subscribe: bool):
+        group = ShardGroup(compiled.params, 2, mode="socket")
+        group.start()
+        rs = ReplicaSet(factory, initial=2)
+        router = Router(rs)
+        stop = threading.Event()
+        pusher = None
+        subs = []
+        try:
+            router.result(router.submit([1] * prompt_len, max_new_tokens=2),
+                          timeout_s=60.0)
+            for rep in rs.serving():
+                rep.engine.metrics.reset()
+            if subscribe:
+                client = group.client()
+                subs = [WeightSubscriber(client, every=1, follow=True)
+                        .attach(rep.engine) for rep in rs.serving()]
+
+                def push_loop():
+                    trainer = group.client()
+                    while not stop.is_set():
+                        trainer.update_parameters(zero_delta)
+                        time.sleep(0.03)
+
+                pusher = threading.Thread(target=push_loop, daemon=True)
+                pusher.start()
+            tps, tokens, results = _fleet_workload(
+                lambda p, n: router.submit(p, max_new_tokens=n),
+                lambda r: router.result(r, timeout_s=120.0),
+                vocab, prompt_len, new_tokens, requests)
+            stop.set()
+            if pusher is not None:
+                pusher.join(timeout=5.0)
+            steady = None
+            if subscribe:
+                # Quiet window: pushes stopped, version static — every
+                # subscriber poll must now cost only not-modified
+                # frames. The byte delta is the steady-state wire tax.
+                polls0 = sum(s.pulls for s in subs)
+                b0 = bytes_tx.value
+                _fleet_workload(
+                    lambda p, n: router.submit(p, max_new_tokens=n),
+                    lambda r: router.result(r, timeout_s=120.0),
+                    vocab, prompt_len, new_tokens, max(4, requests // 3))
+                polls = sum(s.pulls for s in subs) - polls0
+                steady = {
+                    "bytes": bytes_tx.value - b0,
+                    "polls": polls,
+                    "swaps": sum(s.swaps for s in subs),
+                    "unchanged": sum(s.unchanged for s in subs),
+                    "failures": sum(s.failures for s in subs),
+                }
+            itl = max(rep.engine.stats()["itl_s_p99"] or 0.0
+                      for rep in rs.serving())
+            ok = all(r.status == "completed" for r in results)
+            return tokens, itl, ok, steady
+        finally:
+            router.close()
+            group.stop()
+
+    bare_tokens, bare_itl, bare_ok, _ = run_arm(False)
+    swap_tokens, swap_itl, swap_ok, steady = run_arm(True)
+    token_identical = bare_tokens == swap_tokens
+    swap_ratio = (swap_itl / bare_itl) if bare_itl else None
+    assert token_identical, (
+        "mid-stream zero-delta swaps changed the token streams — the "
+        "step-boundary install is not atomic")
+    assert steady["swaps"] >= 1, (
+        "the subscriber arm never actually swapped — the phase proved "
+        "nothing")
+
+    # -- phases 2+3: canary promote, then forced rollback ---------------
+    wal_root = tempfile.mkdtemp(prefix="rollout-bench-wal-")
+    group = ShardGroup(compiled.params, 2, mode="socket",
+                       wal_root=wal_root, wal_keep=16)
+    group.start()
+    rs = ReplicaSet(factory, initial=3)
+    router = Router(rs)
+    ctrl = RolloutController(
+        rs, group.client(), bake_s=0.2, min_results=2,
+        judge=goodput_judge(tolerance=0.5))
+    router.attach_rollout(ctrl)
+    trainer = group.client()
+    real_delta = jax.tree_util.tree_map(
+        lambda a: np.full_like(np.asarray(a), 1e-4), compiled.params)
+    rng = np.random.default_rng(31)
+    all_ok = [True]
+    stale = [0]
+    bad_version = [None]
+
+    def wave(n: int):
+        rids = []
+        for _ in range(n):
+            plen = int(rng.integers(1, prompt_len + 1))
+            prompt = rng.integers(1, vocab, plen).tolist()
+            rids.append(router.submit(prompt, max_new_tokens=new_tokens))
+        for r in rids:
+            res = router.result(r, timeout_s=120.0)
+            all_ok[0] = all_ok[0] and res.status == "completed"
+            router.tick()
+            if bad_version[0] is not None:
+                for rep in rs.serving():
+                    if rep.rollout_canary or rep.engine is None:
+                        continue
+                    if rep.engine.model_version == bad_version[0]:
+                        stale[0] += 1
+
+    try:
+        router.result(router.submit([1] * prompt_len, max_new_tokens=2),
+                      timeout_s=60.0)
+        router.tick()  # seeds the approved baseline (version 0)
+        base = ctrl.doc()["approved_version"]
+        trainer.update_parameters(real_delta)
+        good_version = (base or 0) + 1
+        deadline = time.perf_counter() + 90.0
+        while ctrl.rollouts < 1 and time.perf_counter() < deadline:
+            wave(3)
+        promoted = ctrl.rollouts >= 1
+        converged = promoted and all(
+            rep.engine.model_version == good_version
+            for rep in rs.serving())
+        assert converged, (
+            f"promote arc did not converge: phase={ctrl.doc()['phase']} "
+            f"versions={ctrl.doc()['versions']}")
+
+        ctrl.judge = lambda canary, fleet, window_s, now: False
+        trainer.update_parameters(real_delta)
+        bad_version[0] = good_version + 1
+        deadline = time.perf_counter() + 90.0
+        while ctrl.rollbacks < 1 and time.perf_counter() < deadline:
+            wave(3)
+        doc = ctrl.doc()
+        rolled_back = ctrl.rollbacks >= 1
+        assert rolled_back and doc["approved_version"] == good_version, (
+            f"rollback arc did not converge: phase={doc['phase']} "
+            f"approved={doc['approved_version']}")
+        slo = router.slo.snapshot()
+        rec = {
+            "mode": "fleet_rollout",
+            "replicas": 3,
+            "requests": requests,
+            "token_identical": token_identical,
+            "all_completed": bare_ok and swap_ok and all_ok[0],
+            "swap_itl_p99_ratio": swap_ratio,
+            "itl_s_p99_bare": bare_itl,
+            "itl_s_p99_subscribed": swap_itl,
+            "steady_pull_bytes": steady["bytes"],
+            "steady_pull_polls": steady["polls"],
+            "steady_pull_bytes_per_poll": (
+                steady["bytes"] / steady["polls"] if steady["polls"]
+                else None),
+            "swaps_delivered": steady["swaps"],
+            "pull_failures": steady["failures"],
+            "rollout_promoted": ctrl.rollouts,
+            "rollout_rolled_back": ctrl.rollbacks,
+            "rollback_served_stale": stale[0],
+            "rollout_goodput_ratio": slo["goodput_ratio"],
+            "approved_version": doc["approved_version"],
+            "rejected_version": bad_version[0],
+            "rollout_digest": doc["digest"],
+            "rollout_events": [e["kind"] for e in doc["events"]],
+        }
+    finally:
+        router.close()
+        group.stop()
+        shutil.rmtree(wal_root, ignore_errors=True)
+    assert stale[0] == 0, (
+        f"{stale[0]} non-canary observations served the poisoned "
+        "version — canary containment failed")
+    return rec
+
+
 def main(argv=None) -> list:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--batches", type=int, nargs="+", default=[1, 8, 32])
@@ -1528,6 +1758,13 @@ def main(argv=None) -> list:
                              "prefix hits, and the per-tenant fair-"
                              "share goodput floor (appends to the "
                              "fleet artifact)")
+    parser.add_argument("--rollout", action="store_true",
+                        help="run the live-model-delivery arm: "
+                             "mid-stream swap identity + swap-tax ITL "
+                             "ratio, steady-state subscription bytes, "
+                             "and a full canary promote + forced "
+                             "rollback under a live trainer (appends "
+                             "to the fleet artifact)")
     parser.add_argument("--fleet-out", type=str, default=None,
                         help="write the fleet arms as their own JSON "
                              "artifact (BENCH_FLEET.json)")
@@ -1643,6 +1880,14 @@ def main(argv=None) -> list:
         print(json.dumps(rec))
     if args.disagg:
         rec = bench_fleet_disagg(
+            compiled, args.serving_slots, args.prompt_len, args.new,
+            args.serving_requests,
+        )
+        fleet_records.append(rec)
+        records.append(rec)
+        print(json.dumps(rec))
+    if args.rollout:
+        rec = bench_fleet_rollout(
             compiled, args.serving_slots, args.prompt_len, args.new,
             args.serving_requests,
         )

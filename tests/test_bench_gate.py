@@ -618,3 +618,43 @@ def test_spec_rules_gate_accept_identity_and_itl_ratio():
     plain_by = _checks_by_metric(bg.compare(plain, plain, "serve"))
     assert ("serving/True", "spec_accept_rate") not in plain_by
     assert ("serving/True", "tokens_per_step") not in plain_by
+
+
+def test_rollout_rules_drifted_pass_broken_fail():
+    """The --rollout fleet row. Drift inside the envelope passes: a
+    slower swap tax under the 1.5 ceiling and a lower goodput over the
+    0.5 floor are CI noise, not regressions. Broken is exact: a single
+    non-canary observation of the poisoned version, a non-identical
+    swap stream, a leaked full transfer pushing the tax past ceiling,
+    or a vanished promote/rollback arc each fail on its own rule."""
+    base = [{"mode": "fleet_rollout", "token_identical": True,
+             "all_completed": True, "swap_itl_p99_ratio": 1.05,
+             "rollback_served_stale": 0, "rollout_goodput_ratio": 0.96,
+             "rollout_promoted": 1, "rollout_rolled_back": 1}]
+    drifted = bg.compare(base, [dict(base[0], swap_itl_p99_ratio=1.4,
+                                     rollout_goodput_ratio=0.6)], "fleet")
+    assert all(c["ok"] for c in drifted)
+
+    broken = bg.compare(base, [dict(base[0], token_identical=False,
+                                    swap_itl_p99_ratio=2.1,
+                                    rollback_served_stale=3,
+                                    rollout_goodput_ratio=0.2,
+                                    rollout_promoted=0,
+                                    rollout_rolled_back=0)], "fleet")
+    failed = sorted(c["metric"] for c in broken if not c["ok"])
+    assert failed == ["rollback_served_stale", "rollout_goodput_ratio",
+                      "rollout_promoted", "rollout_rolled_back",
+                      "swap_itl_p99_ratio", "token_identical"]
+    # The containment and tax rules are absolute, not baseline-scaled.
+    by = _checks_by_metric(broken)
+    assert by[("fleet_rollout", "rollback_served_stale")]["threshold"] \
+        == "must equal 0"
+    assert by[("fleet_rollout", "swap_itl_p99_ratio")]["threshold"] == \
+        "must be <= 1.5"
+    # The rollout metrics exist only on the rollout row — the other
+    # fleet arms (no swap tax, no rollback counters) are untouched.
+    other = [{"mode": "fleet_kill", "goodput_ratio_after_kill": 0.9,
+              "all_completed": True}]
+    other_by = _checks_by_metric(bg.compare(other, other, "fleet"))
+    assert ("fleet_kill", "swap_itl_p99_ratio") not in other_by
+    assert ("fleet_kill", "rollback_served_stale") not in other_by

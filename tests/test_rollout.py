@@ -1,0 +1,554 @@
+"""Live model delivery (``elephas_tpu.rollout``).
+
+The contract under test, end to end: training pushes reach serving
+engines ONLY through the subscription plane — installs land atomically
+at decode-step boundaries (token-identical to a restart at the same
+version, never mid-speculative-window), pulls are version-gated (steady
+state is not-modified traffic), failures degrade to serving current
+weights, and fleet-wide the RolloutController's canary arc guarantees
+no non-canary replica ever serves an unapproved version. Rollout
+history is a replay-stable digest.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu import obs
+from elephas_tpu.api.compile import CompiledModel
+from elephas_tpu.models import get_model
+from elephas_tpu.obs.flight import FlightRecorder
+from elephas_tpu.parameter.client import VersionUnavailable
+from elephas_tpu.rollout import RolloutController, WeightSubscriber
+from elephas_tpu.serving import DraftModelSource, InferenceEngine
+
+VOCAB, SEQ = 97, 64
+
+PROMPTS = [
+    ([5, 3, 9], 10),
+    ([7, 2, 8, 4, 1, 6], 12),
+    ([11, 12], 8),
+    ([1, 2, 3, 4], 10),
+    ([42, 7, 7, 13, 2], 9),
+]
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return CompiledModel(
+        get_model(
+            "transformer_lm", vocab_size=VOCAB, d_model=32, num_heads=4,
+            num_layers=2, max_seq_len=SEQ,
+        ),
+        optimizer={"name": "adam", "learning_rate": 3e-3},
+        loss="sparse_categorical_crossentropy",
+        metrics=[],
+        input_shape=(SEQ,),
+        input_dtype=jnp.int32,
+        seed=0,
+    )
+
+
+@pytest.fixture()
+def flight():
+    previous = obs.default_flight_recorder()
+    recorder = FlightRecorder(capacity=256)
+    obs.set_default_flight_recorder(recorder)
+    try:
+        yield recorder
+    finally:
+        obs.set_default_flight_recorder(previous)
+
+
+def _engine(compiled, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("max_len", 24)
+    kw.setdefault("queue_depth", 8)
+    return InferenceEngine(compiled, **kw)
+
+
+def _serve(engine, prompts=PROMPTS):
+    rids = [engine.submit(p, max_new_tokens=n) for p, n in prompts]
+    return [engine.result(r, timeout_s=120) for r in rids]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeVersionedClient:
+    """Stands in for ``ShardedParameterClient.pull``: a versioned tree
+    store with pinned history, injectable failures, and an optional
+    auto-bumping version (every live pull sees a 'new' version —
+    maximal swap pressure with identical content)."""
+
+    def __init__(self, tree, version=0, auto_bump=False):
+        self.trees = {version: tree}
+        self.version = version
+        self.auto_bump = auto_bump
+        self.live_pulls = 0
+        self.pinned_pulls = 0
+        self.fail = False
+
+    def push(self, tree=None):
+        self.version += 1
+        self.trees[self.version] = (
+            tree if tree is not None else self.trees[self.version - 1])
+
+    def prune(self, version):
+        self.trees.pop(version, None)
+
+    def pull(self, version=None):
+        if self.fail:
+            raise ConnectionError("pull failed (injected)")
+        if version is not None:
+            self.pinned_pulls += 1
+            if version not in self.trees:
+                raise VersionUnavailable("fake:0", version)
+            return version, self.trees[version]
+        if self.auto_bump:
+            self.push()
+        self.live_pulls += 1
+        return self.version, self.trees[self.version]
+
+
+# -- subscriber data plane (real engines) ----------------------------------
+
+
+def test_midstream_swap_token_identity(compiled, flight):
+    """Swapping same-content weights at every step boundary mid-stream
+    serves byte-identical streams to a fresh engine at that version —
+    the install is atomic or the decode state would tear."""
+    oracle = [r.tokens for r in _serve(_engine(compiled))]
+    eng = _engine(compiled)
+    host_tree = jax.tree_util.tree_map(np.asarray, eng.params)
+    client = FakeVersionedClient(host_tree, auto_bump=True)
+    sub = WeightSubscriber(client, every=1, follow=True).attach(eng)
+    results = _serve(eng)
+    assert [r.tokens for r in results] == oracle
+    assert all(r.status == "completed" for r in results)
+    assert sub.swaps >= 2, "no mid-stream swap actually happened"
+    assert eng.model_version == client.version
+    assert eng.stats()["model_version"] == client.version
+    kinds = [e.kind for e in flight.events()]
+    assert "weight_swap" in kinds
+
+
+def test_pull_failure_degrades_without_dropping(compiled, flight):
+    """A dead PS costs telemetry, never requests: the engine keeps
+    serving its current weights, streams stay identical, and the
+    failures surface as ``weight_pull_fail`` flight notes."""
+    oracle = [r.tokens for r in _serve(_engine(compiled))]
+    eng = _engine(compiled)
+    client = FakeVersionedClient({})
+    client.fail = True
+    sub = WeightSubscriber(client, every=1, follow=True).attach(eng)
+    results = _serve(eng)
+    assert [r.tokens for r in results] == oracle
+    assert all(r.status == "completed" for r in results)
+    assert sub.failures >= 2
+    assert sub.swaps == 0
+    assert eng.model_version is None
+    assert "weight_pull_fail" in [e.kind for e in flight.events()]
+
+
+def test_spec_engine_never_swaps_mid_verify(compiled, monkeypatch):
+    """One scheduler step is one draft+verify window, and the swap hook
+    runs after the step — so the params object a window dispatches with
+    is the params object it finishes with, even under per-step swap
+    pressure."""
+    from elephas_tpu.serving import spec as spec_mod
+
+    orig = spec_mod.SpeculativeDecoder.dispatch
+    windows = []
+
+    def wrapped(self, *args, **kwargs):
+        before = id(self.engine.params)
+        out = orig(self, *args, **kwargs)
+        windows.append((before, id(self.engine.params)))
+        return out
+
+    monkeypatch.setattr(spec_mod.SpeculativeDecoder, "dispatch", wrapped)
+    oracle = [r.tokens for r in _serve(
+        _engine(compiled, speculative=True, gamma=3, draft_layers=1))]
+    eng = _engine(compiled, speculative=True, gamma=3, draft_layers=1)
+    host_tree = jax.tree_util.tree_map(np.asarray, eng.params)
+    sub = WeightSubscriber(
+        FakeVersionedClient(host_tree, auto_bump=True),
+        every=1, follow=True).attach(eng)
+    spec = [r.tokens for r in _serve(eng)]
+    assert spec == oracle
+    assert windows, "no speculative window ever dispatched"
+    assert all(before == after for before, after in windows), (
+        "a weight swap landed inside a draft+verify window")
+    assert sub.swaps >= 1
+
+
+def test_follow_pull_counters_version_gated():
+    """Steady state is all not-modified: pulls keep counting, installs
+    don't. A version bump costs exactly one swap."""
+
+    class MiniEngine:
+        model_version = None
+        subscriber = None
+        spec = None
+
+        def install_weights(self, tree, version=None):
+            self.model_version = version
+
+    eng = MiniEngine()
+    client = FakeVersionedClient({"w": 1})
+    sub = WeightSubscriber(client, every=1, follow=True).attach(eng)
+    for _ in range(10):
+        sub.on_step(eng)
+    assert sub.pulls == 10
+    assert sub.swaps == 1          # the first delivery
+    assert sub.unchanged == 9      # then not-modified steady state
+    client.push({"w": 2})
+    for _ in range(5):
+        sub.on_step(eng)
+    assert sub.swaps == 2
+    assert sub.unchanged == 13
+    assert eng.model_version == client.version
+    # cadence: every=3 polls on 1/3 of the steps
+    eng2 = MiniEngine()
+    sub2 = WeightSubscriber(FakeVersionedClient({"w": 1}),
+                            every=3, follow=True).attach(eng2)
+    for _ in range(9):
+        sub2.on_step(eng2)
+    assert sub2.pulls == 3
+
+
+def test_draft_and_target_share_one_cadence(compiled):
+    """A subscribed ``DraftModelSource`` never self-polls: one cold
+    pull, then refreshes ride the target subscriber's cadence."""
+    host_tree = jax.tree_util.tree_map(np.asarray, compiled.params)
+
+    class CountingClient:
+        def __init__(self):
+            self.pulls = 0
+
+        def get_parameters(self):
+            self.pulls += 1
+            return compiled.params
+
+    draft_client = CountingClient()
+    source = DraftModelSource(compiled.module, draft_client,
+                              subscribed=True)
+    eng = _engine(compiled, speculative=True, gamma=3,
+                  prefix_cache=False, draft_source=source)
+    _serve(eng)
+    assert draft_client.pulls == 1, (
+        "a subscribed draft source self-polled without a subscriber")
+    target_client = FakeVersionedClient(host_tree, auto_bump=True)
+    sub = WeightSubscriber(target_client, every=1, follow=True).attach(eng)
+    assert sub.draft is source  # adopted from engine.spec.source
+    _serve(eng)
+    # one draft refresh per successful target poll, plus the cold pull
+    assert draft_client.pulls == 1 + sub.pulls
+    assert source.pulls == draft_client.pulls
+
+
+# -- controller policy plane (fakes) ---------------------------------------
+
+
+class FakeLedger:
+    def __init__(self):
+        self.evaluated = 0
+        self.good = 1.0
+
+    def snapshot(self, now=None):
+        return {"evaluated": self.evaluated}
+
+    def goodput(self, window_s, now=None):
+        return {"itl": self.good}
+
+
+class FakeEngine:
+    def __init__(self):
+        self.model_version = None
+        self.subscriber = None
+        self.spec = None
+        self.slo = FakeLedger()
+        self.params = {"w": np.zeros(2)}
+
+    def install_weights(self, tree, version=None):
+        self.params = tree
+        self.model_version = None if version is None else int(version)
+
+    def step(self):
+        if self.subscriber is not None:
+            self.subscriber.on_step(self)
+
+
+class FakeReplica:
+    def __init__(self, rid, tier):
+        self.replica_id = rid
+        self.tier = tier
+        self.state = "serving"
+        self.engine = FakeEngine()
+        self.rollout_canary = False
+
+
+class FakeSet:
+    def __init__(self, reps):
+        self.replicas = {r.replica_id: r for r in reps}
+
+    def serving(self, tier=None):
+        return [r for r in self.replicas.values()
+                if r.state == "serving"
+                and (tier is None or r.tier == tier)]
+
+
+def _fleet(tiers):
+    reps = [FakeReplica(f"r{i}", t) for i, t in enumerate(tiers)]
+    return reps, FakeSet(reps)
+
+
+def _step_all(reps, n=1):
+    for _ in range(n):
+        for r in reps:
+            r.engine.step()
+
+
+def _drive_canary_to_verdict(ctrl, clock, canary, n_results=5):
+    """tick through: baseline seed → canary pin → install → bake."""
+    ctrl.tick()                      # seed baseline (v0)
+    ctrl.tick()                      # see the push, pin the canary
+    canary.engine.step()             # canary installs at its boundary
+    clock.advance(10.0)
+    canary.engine.slo.evaluated = n_results
+    return ctrl.tick()               # bake satisfied → judge → verdict
+
+
+def test_good_canary_promotes_tier_ordered(flight):
+    reps, rs = _fleet(["prefill", "prefill", "mono", "decode", "decode"])
+    client = FakeVersionedClient({"w": np.zeros(2)})
+    clock = FakeClock()
+    ctrl = RolloutController(rs, client, bake_s=1.0, min_results=2,
+                             judge=lambda *a: True, clock=clock)
+    ctrl.tick()
+    client.push({"w": np.ones(2)})
+    phase = _drive_canary_to_verdict(ctrl, clock, reps[0])
+    assert phase == "promoting"
+    assert reps[0].tier == "prefill" and reps[0].rollout_canary
+
+    def pins_by_tier():
+        return [(e["tier"], e["replica"]) for e in ctrl.doc()["events"]
+                if e["kind"] == "pin"]
+
+    # wave 1: only the remaining prefill replica is pinned, and
+    # re-ticking before it converges must NOT advance the ripple
+    assert pins_by_tier() == [("prefill", "r1")]
+    ctrl.tick()
+    assert pins_by_tier() == [("prefill", "r1")]
+    _step_all(reps)
+    ctrl.tick()   # prefill converged → mono wave
+    assert pins_by_tier() == [("prefill", "r1"), ("mono", "r2")]
+    _step_all(reps)
+    ctrl.tick()   # mono converged → decode wave
+    _step_all(reps)
+    assert ctrl.tick() == "idle"
+    assert [t for t, _ in pins_by_tier()] == [
+        "prefill", "mono", "decode", "decode"]
+    assert ctrl.doc()["approved_version"] == 1
+    assert all(r.engine.model_version == 1 for r in reps)
+    assert not reps[0].rollout_canary
+    assert "rollout_promote" in [e.kind for e in flight.events()]
+
+
+def test_bad_canary_rolls_back_pinned(flight):
+    reps, rs = _fleet(["prefill", "decode", "decode"])
+    client = FakeVersionedClient({"w": np.zeros(2)})
+    clock = FakeClock()
+    ctrl = RolloutController(rs, client, bake_s=1.0, min_results=2,
+                             judge=lambda *a: False, clock=clock)
+    ctrl.tick()
+    client.push({"w": np.full(2, 9.0)})
+    phase = _drive_canary_to_verdict(ctrl, clock, reps[0])
+    assert phase == "rollback"
+    sub = ctrl.subscriber_of("r0")
+    assert sub.pinned == 0           # re-pinned to the approved prior
+    reps[0].engine.step()            # pinned pull restores v0
+    assert ctrl.tick() == "idle"
+    assert reps[0].engine.model_version == 0
+    assert ctrl.rollbacks == 1
+    # the poisoned version never touched a non-canary replica
+    assert all(r.engine.model_version != 1 for r in reps[1:])
+    # and is rejected: the next tick does NOT re-canary it
+    assert ctrl.tick() == "idle"
+    assert ctrl.doc()["candidate_version"] is None
+    kinds = [e["kind"] for e in ctrl.doc()["events"]]
+    assert kinds == ["baseline", "canary_start", "rollback_start",
+                     "rolled_back"]
+    assert "rollout_rollback" in [e.kind for e in flight.events()]
+
+
+def test_rollback_peer_copy_when_wal_pruned(flight):
+    """The WAL pruning the approved version must not strand a bad
+    canary: the controller stages a healthy peer's live tree."""
+    reps, rs = _fleet(["prefill", "decode"])
+    client = FakeVersionedClient({"w": np.zeros(2)})
+    clock = FakeClock()
+    ctrl = RolloutController(rs, client, bake_s=1.0, min_results=2,
+                             judge=lambda *a: False, clock=clock)
+    ctrl.tick()
+    client.push({"w": np.full(2, 9.0)})
+    client.prune(0)                  # trainer outran the WAL window
+    phase = _drive_canary_to_verdict(ctrl, clock, reps[0])
+    assert phase == "rollback"
+    reps[0].engine.step()            # pinned pull → VersionUnavailable
+    assert ctrl.subscriber_of("r0").pin_failed
+    ctrl.tick()                      # peer-copy fallback staged
+    reps[0].engine.step()            # offer installs at the boundary
+    assert ctrl.tick() == "idle"
+    assert reps[0].engine.model_version == 0
+    assert "rollback_peer_copy" in [
+        e["kind"] for e in ctrl.doc()["events"]]
+
+
+def test_nudge_delivers_to_idle_engine(flight):
+    """Delivery must not depend on traffic: a replica with no requests
+    has no decode-step boundaries, so the controller hands it a
+    synthetic one (``nudge``) — taken only when the step lock is free,
+    which is the exact idle-between-steps invariant the real boundary
+    hook runs under. A held lock (engine mid-step) blocks the nudge."""
+    import threading
+
+    reps, rs = _fleet(["prefill", "decode", "decode"])
+    for r in reps:
+        r.engine._step_lock = threading.Lock()
+    client = FakeVersionedClient({"w": np.zeros(2)})
+    clock = FakeClock()
+    ctrl = RolloutController(rs, client, bake_s=1.0, min_results=2,
+                             judge=lambda *a: True, clock=clock)
+    ctrl.tick()
+    client.push({"w": np.ones(2)})
+    ctrl.tick()                      # pin the canary
+    clock.advance(10.0)
+    reps[0].engine.slo.evaluated = 5
+    # NO explicit engine.step() anywhere: ticks alone must converge the
+    # whole fleet — canary install, bake, and both promote waves.
+    for _ in range(6):
+        if ctrl.tick() == "idle" and ctrl.rollouts:
+            break
+    assert ctrl.rollouts == 1
+    assert all(r.engine.model_version == 1 for r in reps)
+    # a busy engine (step lock held) cannot be nudged mid-step
+    sub = ctrl.subscriber_of("r1")
+    with reps[1].engine._step_lock:
+        assert sub.nudge(reps[1].engine) is False
+    assert sub.nudge(reps[1].engine) is True
+
+
+def test_rollout_digest_replay_stable(flight):
+    """Same arc, different wall-clock pacing → identical digest: the
+    event log carries sequence and identity, never time."""
+
+    def run(bake_advance):
+        reps, rs = _fleet(["prefill", "decode"])
+        client = FakeVersionedClient({"w": np.zeros(2)})
+        clock = FakeClock()
+        ctrl = RolloutController(rs, client, bake_s=1.0, min_results=1,
+                                 judge=lambda *a: True, clock=clock)
+        ctrl.tick()
+        client.push({"w": np.ones(2)})
+        ctrl.tick()
+        reps[0].engine.step()
+        clock.advance(bake_advance)
+        reps[0].engine.slo.evaluated = 3
+        ctrl.tick()
+        _step_all(reps)
+        ctrl.tick()
+        _step_all(reps)
+        ctrl.tick()
+        doc = ctrl.doc()
+        assert doc["approved_version"] == 1
+        for event in doc["events"]:
+            assert set(event) <= {"seq", "kind", "version", "replica",
+                                  "tier", "to"}, "a timestamp leaked in"
+        return doc["digest"]
+
+    assert run(2.0) == run(500.0)
+
+
+def test_doc_and_gauges(flight):
+    reps, rs = _fleet(["prefill", "decode"])
+    client = FakeVersionedClient({"w": np.zeros(2)})
+    clock = FakeClock()
+    ctrl = RolloutController(rs, client, bake_s=5.0, min_results=1,
+                             judge=lambda *a: True, clock=clock)
+    ctrl.tick()
+    client.push({"w": np.ones(2)})
+    ctrl.tick()
+    reps[0].engine.step()
+    clock.advance(2.0)
+    ctrl.tick()                      # still baking
+    doc = ctrl.doc()
+    assert doc["active"] and doc["phase"] == "canary"
+    assert doc["canary"] == "r0"
+    assert doc["candidate_version"] == 1
+    assert doc["versions"]["r0"] == 1
+    # canary excluded from skew: one replica ahead during bake is the
+    # arc working, not an incident
+    assert doc["skew"] == 0
+    metrics = obs.default_registry().snapshot()
+    assert metrics["fleet_rollout_age_s"] == pytest.approx(2.0)
+    assert metrics["fleet_version_skew"] == 0.0
+
+
+def test_ps_outage_stalls_delivery_not_serving(flight):
+    reps, rs = _fleet(["prefill", "decode"])
+    client = FakeVersionedClient({"w": np.zeros(2)})
+    ctrl = RolloutController(rs, client, clock=FakeClock())
+    ctrl.tick()
+    client.fail = True
+    assert ctrl.tick() == "idle"
+    assert ctrl.probe_failures == 1
+    _step_all(reps)                  # held subscribers: zero traffic
+    assert client.live_pulls == 1    # only the first idle probe pulled
+
+
+# -- version-pinning plane (real PS group over the wire) -------------------
+
+
+def test_pinned_pull_serves_wal_history(tmp_path):
+    """``pull(version=)`` answers from WAL history while the live
+    version advances — and a pruned version is a definitive
+    ``VersionUnavailable``, not a hang."""
+    from elephas_tpu.parameter import ShardGroup
+
+    params = {"a": np.arange(4, dtype=np.float32),
+              "b": np.ones((2, 3), dtype=np.float32)}
+    delta = {"a": np.full(4, 0.5, dtype=np.float32),
+             "b": np.full((2, 3), 0.25, dtype=np.float32)}
+    group = ShardGroup(params, 2, mode="socket",
+                       wal_root=str(tmp_path), wal_keep=4)
+    group.start()
+    try:
+        client = group.client()
+        client.update_parameters(delta)
+        client.update_parameters(delta)
+        live_version, live = client.pull()
+        assert live_version == 2
+        np.testing.assert_allclose(np.asarray(live["a"]),
+                                   params["a"] - 1.0)
+        pinned_version, pinned = client.pull(version=1)
+        assert pinned_version == 1
+        np.testing.assert_allclose(np.asarray(pinned["a"]),
+                                   params["a"] - 0.5)
+        np.testing.assert_allclose(np.asarray(pinned["b"]),
+                                   params["b"] - 0.25)
+        with pytest.raises(VersionUnavailable):
+            client.pull(version=99)
+    finally:
+        group.stop()
